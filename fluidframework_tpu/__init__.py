@@ -1,0 +1,12 @@
+"""fluidframework_tpu — a TPU-native collaborative-data framework.
+
+Brand-new implementation of the Fluid Framework capability set
+(distributed data structures, op sequencing service, summarization,
+reconnect/rebase, GC) designed JAX/XLA-first: the merge/rebase/sequencing
+hot loops run as vectorized kernels over struct-of-arrays tensors,
+batched across thousands of documents per dispatch.
+
+See DESIGN.md and SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,42 @@
+"""Wire protocol: ops, quorum, sequence-number sentinels.
+
+Reference analogue: common/lib/protocol-definitions +
+server/routerlicious/packages/protocol-base.
+"""
+from .constants import (
+    MAX_SEQ,
+    NON_COLLAB_CLIENT,
+    TREE_MAINT_SEQ,
+    UNASSIGNED_SEQ,
+    UNIVERSAL_SEQ,
+)
+from .messages import (
+    ClientDetail,
+    DocumentMessage,
+    MessageType,
+    Nack,
+    NackErrorType,
+    SequencedMessage,
+    Trace,
+    is_system_message,
+)
+from .quorum import ProtocolOpHandler, QuorumClients, QuorumProposals
+
+__all__ = [
+    "MAX_SEQ",
+    "NON_COLLAB_CLIENT",
+    "TREE_MAINT_SEQ",
+    "UNASSIGNED_SEQ",
+    "UNIVERSAL_SEQ",
+    "ClientDetail",
+    "DocumentMessage",
+    "MessageType",
+    "Nack",
+    "NackErrorType",
+    "SequencedMessage",
+    "Trace",
+    "is_system_message",
+    "ProtocolOpHandler",
+    "QuorumClients",
+    "QuorumProposals",
+]

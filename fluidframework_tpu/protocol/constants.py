@@ -1,0 +1,22 @@
+"""Sequence-number sentinels shared by the whole framework.
+
+Reference: packages/dds/merge-tree/src/constants.ts:11-15.
+"""
+
+# Seq for content that existed before collaboration started (snapshot load).
+UNIVERSAL_SEQ = 0
+
+# Seq for local, not-yet-acked ops/segments.
+UNASSIGNED_SEQ = -1
+
+# Seq used for structural tree maintenance that is not an op.
+TREE_MAINT_SEQ = -2
+
+# Client id used when not collaborating.
+NON_COLLAB_CLIENT = -2
+
+# Normalised comparison values for tie-breaking (mergeTree.ts:1705):
+# a local pending *op* compares as the highest possible seq; a local
+# pending *segment* as the second highest (the op being placed always
+# sequences after segments already in the tree).
+MAX_SEQ = 2**53 - 1

@@ -1,0 +1,7 @@
+"""Service plane: sequencer (deli), orderer pipeline, ingress.
+
+Reference analogue: server/routerlicious/packages/*.
+"""
+from .sequencer import DocumentSequencer, TicketResult
+
+__all__ = ["DocumentSequencer", "TicketResult"]

@@ -1,0 +1,7 @@
+"""Shared utilities: events, telemetry, config.
+
+Reference analogue: common/lib/common-utils, packages/utils/telemetry-utils.
+"""
+from .events import EventEmitter
+
+__all__ = ["EventEmitter"]

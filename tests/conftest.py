@@ -1,0 +1,13 @@
+"""Test environment: force an 8-device virtual CPU mesh so every
+multi-chip sharding path is exercised without TPU hardware.
+
+Must run before the first `import jax` anywhere in the test session.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

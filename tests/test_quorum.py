@@ -1,0 +1,59 @@
+"""Quorum + ProtocolOpHandler tests (protocol-base/src/quorum.ts)."""
+import json
+
+import pytest
+
+from fluidframework_tpu.protocol.quorum import ProtocolError
+from fluidframework_tpu.protocol import (
+    ClientDetail,
+    MessageType,
+    ProtocolOpHandler,
+    SequencedMessage,
+)
+
+
+def seq_msg(seq, msn, msg_type, contents):
+    return SequencedMessage(
+        client_id=None,
+        sequence_number=seq,
+        minimum_sequence_number=msn,
+        client_sequence_number=-1,
+        reference_sequence_number=-1,
+        type=msg_type,
+        contents=contents,
+    )
+
+
+def test_join_leave_updates_quorum():
+    h = ProtocolOpHandler()
+    h.process_message(seq_msg(1, 0, MessageType.CLIENT_JOIN, ClientDetail("A")))
+    h.process_message(seq_msg(2, 0, MessageType.CLIENT_JOIN, ClientDetail("B")))
+    assert set(h.quorum.members) == {"A", "B"}
+    h.process_message(seq_msg(3, 0, MessageType.CLIENT_LEAVE, "A"))
+    assert set(h.quorum.members) == {"B"}
+
+
+def test_proposal_commits_when_msn_passes():
+    h = ProtocolOpHandler()
+    h.process_message(
+        seq_msg(1, 0, MessageType.PROPOSE, ("code", "v2"))
+    )
+    assert not h.proposals.has("code")  # msn 0 < proposal seq 1
+    h.process_message(seq_msg(2, 1, MessageType.OPERATION, None))
+    assert h.proposals.get("code") == "v2"
+
+
+def test_noncontiguous_seq_raises():
+    h = ProtocolOpHandler()
+    h.process_message(seq_msg(1, 0, MessageType.OPERATION, None))
+    with pytest.raises(ProtocolError):
+        h.process_message(seq_msg(3, 0, MessageType.OPERATION, None))
+
+
+def test_snapshot_contains_attributes():
+    h = ProtocolOpHandler()
+    h.process_message(seq_msg(1, 0, MessageType.CLIENT_JOIN, ClientDetail("A")))
+    snap = h.snapshot()
+    assert snap["sequenceNumber"] == 1
+    assert "A" in snap["members"]
+    json.dumps(snap)  # summary blobs must be JSON-safe

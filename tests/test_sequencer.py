@@ -1,0 +1,127 @@
+"""DocumentSequencer (deli ticket) unit tests.
+
+Mirrors the reference's deli lambda tests
+(server/routerlicious/packages/lambdas/src/test)."""
+from fluidframework_tpu.protocol import (
+    ClientDetail,
+    DocumentMessage,
+    MessageType,
+    NackErrorType,
+)
+from fluidframework_tpu.service import DocumentSequencer
+
+
+def op(csn, refseq, contents=None):
+    return DocumentMessage(
+        client_sequence_number=csn,
+        reference_sequence_number=refseq,
+        type=MessageType.OPERATION,
+        contents=contents,
+    )
+
+
+def test_join_assigns_seq_and_msn():
+    seq = DocumentSequencer("doc")
+    join = seq.client_join(ClientDetail("A"))
+    assert join.sequence_number == 1
+    assert join.type == MessageType.CLIENT_JOIN
+    assert join.minimum_sequence_number <= join.sequence_number
+
+
+def test_ticket_stamps_monotone_seq():
+    s = DocumentSequencer("doc")
+    s.client_join(ClientDetail("A"))
+    r1 = s.ticket("A", op(1, 1))
+    r2 = s.ticket("A", op(2, 2))
+    assert r1.ok and r2.ok
+    assert r1.message.sequence_number == 2
+    assert r2.message.sequence_number == 3
+    assert r2.message.client_sequence_number == 2
+
+
+def test_msn_is_min_refseq_over_clients():
+    s = DocumentSequencer("doc")
+    s.client_join(ClientDetail("A"))  # seq 1, A.refSeq = 1
+    s.client_join(ClientDetail("B"))  # seq 2, B.refSeq = 2
+    r = s.ticket("A", op(1, 1))  # seq 3; msn = min(1, 2) = 1
+    assert r.message.minimum_sequence_number == 1
+    r = s.ticket("B", op(1, 2))  # B.refSeq=2; msn = min(1,2) = 1
+    assert r.message.minimum_sequence_number == 1
+    r = s.ticket("A", op(2, 3))  # A.refSeq=3; msn = min(3,2) = 2
+    assert r.message.minimum_sequence_number == 2
+
+
+def test_msn_never_regresses_on_join_leave_churn():
+    s = DocumentSequencer("doc")
+    s.client_join(ClientDetail("A"))
+    for i in range(5):
+        s.ticket("A", op(i + 1, s.sequence_number))
+    msn_before = s.minimum_sequence_number
+    s.client_leave("A")
+    j = s.client_join(ClientDetail("B"))
+    assert j.minimum_sequence_number >= msn_before
+
+
+def test_redundant_join_does_not_reset_sequencing_state():
+    s = DocumentSequencer("doc")
+    s.client_join(ClientDetail("A"))
+    for i in range(3):
+        assert s.ticket("A", op(i + 1, s.sequence_number)).ok
+    s.client_join(ClientDetail("A"))  # at-least-once ingress retry
+    replayed = s.ticket("A", op(1, s.sequence_number))  # old op replayed
+    assert replayed.message is None and replayed.nack is None  # dropped
+    fresh = s.ticket("A", op(4, s.sequence_number))
+    assert fresh.ok
+
+
+def test_unknown_client_nacked():
+    s = DocumentSequencer("doc")
+    r = s.ticket("ghost", op(1, 0))
+    assert not r.ok
+    assert r.nack.error_type == NackErrorType.BAD_REQUEST
+
+
+def test_duplicate_csn_dropped_and_gap_nacked():
+    s = DocumentSequencer("doc")
+    s.client_join(ClientDetail("A"))
+    assert s.ticket("A", op(1, 1)).ok
+    dup = s.ticket("A", op(1, 1))  # duplicate: dropped, no nack
+    assert dup.message is None and dup.nack is None
+    gap = s.ticket("A", op(5, 1))  # gap: nacked
+    assert gap.nack is not None
+
+
+def test_stale_refseq_nacked():
+    s = DocumentSequencer("doc")
+    s.client_join(ClientDetail("A"))
+    s.client_join(ClientDetail("B"))
+    for i in range(10):
+        s.ticket("A", op(i + 1, s.sequence_number))
+    s.ticket("B", op(1, s.sequence_number))  # advance B so msn moves
+    s.ticket("A", op(11, s.sequence_number))
+    stale = s.ticket("B", op(2, 0))  # refSeq 0 < msn
+    assert stale.nack is not None
+
+
+def test_future_refseq_nacked():
+    s = DocumentSequencer("doc")
+    s.client_join(ClientDetail("A"))
+    r = s.ticket("A", op(1, 99))
+    assert r.nack is not None
+
+
+def test_checkpoint_roundtrip():
+    s = DocumentSequencer("doc")
+    s.client_join(ClientDetail("A"))
+    s.client_join(ClientDetail("B"))
+    s.ticket("A", op(1, 1))
+    s.ticket("B", op(1, 2))
+    state = s.checkpoint()
+    restored = DocumentSequencer.restore(state)
+    r1 = s.ticket("A", op(2, 3))
+    r2 = restored.ticket("A", op(2, 3))
+    assert r1.message.sequence_number == r2.message.sequence_number
+    assert (
+        r1.message.minimum_sequence_number
+        == r2.message.minimum_sequence_number
+    )
