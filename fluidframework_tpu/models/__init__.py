@@ -1,0 +1,4 @@
+"""DDS layer: the distributed data structures.
+
+Reference analogue: packages/dds/*.
+"""

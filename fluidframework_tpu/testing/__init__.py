@@ -1,0 +1,14 @@
+"""Test infrastructure: mock sequencer sessions, seeded fuzzing.
+
+Reference analogue: packages/runtime/test-runtime-utils,
+packages/test/stochastic-test-utils.
+"""
+from .fuzz import FuzzConfig, record_op_stream, run_convergence_fuzz
+from .mocks import MockCollabSession
+
+__all__ = [
+    "FuzzConfig",
+    "MockCollabSession",
+    "record_op_stream",
+    "run_convergence_fuzz",
+]

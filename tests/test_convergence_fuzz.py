@@ -1,0 +1,34 @@
+"""Seeded convergence fuzzing over the mock sequencer — the framework's
+race detector (SURVEY §5.2). Mirrors the reference's stochastic tests
+(packages/dds/merge-tree/src/test/*fuzz*)."""
+import pytest
+
+from fluidframework_tpu.testing import FuzzConfig, run_convergence_fuzz
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_three_client_convergence(seed):
+    run_convergence_fuzz(FuzzConfig(n_clients=3, n_steps=150, seed=seed))
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_many_client_convergence(seed):
+    run_convergence_fuzz(
+        FuzzConfig(n_clients=6, n_steps=250, seed=1000 + seed)
+    )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_insert_heavy_convergence(seed):
+    run_convergence_fuzz(FuzzConfig(
+        n_clients=4, n_steps=200, insert_weight=0.8, remove_weight=0.05,
+        annotate_weight=0.05, process_weight=0.1, seed=2000 + seed,
+    ))
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_remove_heavy_convergence(seed):
+    run_convergence_fuzz(FuzzConfig(
+        n_clients=3, n_steps=200, insert_weight=0.35, remove_weight=0.45,
+        annotate_weight=0.05, process_weight=0.15, seed=3000 + seed,
+    ))

@@ -1,0 +1,197 @@
+"""Merge-tree concurrency semantics tests.
+
+Mirrors the reference's merge-tree test approach
+(packages/dds/merge-tree/src/test): multi-client sessions over a mock
+sequencer, interleaved ops, convergence asserts. Each concurrency case
+encodes a behavior pinned by mergeTree.ts (breakTie :1705,
+markRangeRemoved :1908, nodeLength :984).
+"""
+import pytest
+
+from fluidframework_tpu.testing import MockCollabSession
+
+
+def make(n=2):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    return MockCollabSession(ids), ids
+
+
+def test_single_client_insert_remove():
+    s, _ = make(1)
+    s.do("A", "insert_text_local", 0, "hello world")
+    s.do("A", "remove_range_local", 5, 11)
+    s.process_all()
+    assert s.assert_converged() == "hello"
+
+
+def test_sequential_inserts_converge():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "abc")
+    s.process_all()
+    s.do("B", "insert_text_local", 3, "def")
+    s.process_all()
+    assert s.assert_converged() == "abcdef"
+
+
+def test_concurrent_same_position_inserts_later_seq_leftmost():
+    """breakTie (mergeTree.ts:1705): among concurrent same-position
+    inserts, the later-sequenced one lands leftmost."""
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "aaa")  # sequenced first
+    s.do("B", "insert_text_local", 0, "bbb")  # sequenced second
+    s.process_all()
+    assert s.assert_converged() == "bbbaaa"
+
+
+def test_concurrent_insert_ordering_is_not_submission_order_dependent():
+    """Three-way concurrent inserts at 0: final order is strictly by
+    descending seq regardless of client identity."""
+    s, _ = make(3)
+    s.do("A", "insert_text_local", 0, "1")   # seq n
+    s.do("B", "insert_text_local", 0, "2")   # seq n+1
+    s.do("C", "insert_text_local", 0, "3")   # seq n+2
+    s.process_all()
+    assert s.assert_converged() == "321"
+
+
+def test_local_pending_stays_left_of_concurrent_remote():
+    """While A's op is unacked, a concurrent remote insert at the same
+    position must land to its right on A (and on everyone once
+    sequenced): A's op sequences later => leftmost."""
+    s, _ = make(2)
+    s.do("B", "insert_text_local", 0, "remote")  # sequenced first
+    s.do("A", "insert_text_local", 0, "local")   # sequenced second
+    # Deliver B's op to A while A's own op is still pending.
+    s.process_some(1)
+    assert s.client("A").get_text() == "localremote"
+    s.process_all()
+    assert s.assert_converged() == "localremote"
+
+
+def test_insert_into_concurrently_removed_range_survives():
+    """A remove does not affect inserts it could not see
+    (nodeMap skips len-0; nodeLength :984)."""
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "abcdef")
+    s.process_all()
+    s.do("A", "remove_range_local", 0, 6)     # sequenced first
+    s.do("B", "insert_text_local", 3, "XYZ")  # concurrent, lands mid-range
+    s.process_all()
+    assert s.assert_converged() == "XYZ"
+
+
+def test_concurrent_insert_at_remove_boundary():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "abcdef")
+    s.process_all()
+    s.do("A", "remove_range_local", 2, 4)
+    s.do("B", "insert_text_local", 2, "XX")
+    s.process_all()
+    assert s.assert_converged() == "abXXef"
+
+
+def test_overlapping_removes_are_idempotent():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "abcdef")
+    s.process_all()
+    s.do("A", "remove_range_local", 1, 5)
+    s.do("B", "remove_range_local", 2, 6)  # overlaps [2,5)
+    s.process_all()
+    assert s.assert_converged() == "a"
+
+
+def test_remove_of_own_pending_insert():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "abc")
+    s.do("A", "remove_range_local", 1, 2)  # removes own pending 'b'
+    s.process_all()
+    assert s.assert_converged() == "ac"
+
+
+def test_concurrent_remove_and_annotate():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "abcd")
+    s.process_all()
+    s.do("A", "remove_range_local", 0, 2)
+    s.do("B", "annotate_range_local", 0, 4, {"bold": True})
+    s.process_all()
+    assert s.assert_converged() == "cd"
+    # surviving segments carry the annotation
+    for seg in s.client("A").mergetree.segments:
+        if not seg.removed:
+            assert seg.props == {"bold": True}
+
+
+def test_annotate_lww_by_sequence_order():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "ab")
+    s.process_all()
+    s.do("A", "annotate_range_local", 0, 2, {"c": 1})  # sequenced first
+    s.do("B", "annotate_range_local", 0, 2, {"c": 2})  # sequenced second
+    s.process_all()
+    s.assert_converged()
+    for cid in ("A", "B"):
+        for seg in s.client(cid).mergetree.segments:
+            if not seg.removed:
+                assert seg.props["c"] == 2, f"client {cid}"
+
+
+def test_annotate_pending_local_wins_until_ack():
+    """segmentPropertiesManager.ts:29 — a pending local annotate shields
+    the key from remote values; consistent because the local op
+    sequences later and wins LWW anyway."""
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "ab")
+    s.process_all()
+    s.do("B", "annotate_range_local", 0, 2, {"c": "remote"})  # seq first
+    s.do("A", "annotate_range_local", 0, 2, {"c": "local"})   # seq second
+    s.process_some(1)  # B's remote annotate arrives while A's pending
+    seg = s.client("A").mergetree.segments[0]
+    assert seg.props["c"] == "local"
+    s.process_all()
+    s.assert_converged()
+    for cid in ("A", "B"):
+        seg = s.client(cid).mergetree.segments[0]
+        assert seg.props["c"] == "local"
+
+
+def test_zamboni_compacts_below_window():
+    s, ids = make(2)
+    for i in range(6):
+        s.do("A", "insert_text_local", 0, "ab")
+        s.do("B", "insert_text_local", 0, "cd")
+        s.process_all()
+    s.do("A", "remove_range_local", 0, 4)
+    s.process_all()
+    text = s.assert_converged()
+    # noop-style traffic to advance msn to the tip
+    s.do("A", "insert_text_local", 0, "x")
+    s.process_all()
+    s.do("B", "insert_text_local", 0, "y")
+    s.process_all()
+    final = s.assert_converged()
+    for cid in ids:
+        tree = s.client(cid).mergetree
+        assert all(
+            not (seg.removal_acked
+                 and seg.removed_seq <= tree.collab.min_seq)
+            for seg in tree.segments
+        ), "tombstones below min_seq must be zambonied"
+    assert final == "y" + "x" + text
+
+
+def test_marker_insert_and_text_skips_marker():
+    from fluidframework_tpu.models.mergetree import ReferenceType
+
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "ab")
+    s.do("A", "insert_marker_local", 1, ReferenceType.TILE)
+    s.process_all()
+    assert s.assert_converged() == "ab"  # marker occupies a position
+    assert s.client("B").get_length() == 3
+
+
+def test_insert_beyond_length_raises():
+    s, _ = make(1)
+    with pytest.raises(ValueError):
+        s.do("A", "insert_text_local", 5, "late")

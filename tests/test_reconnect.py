@@ -1,0 +1,152 @@
+"""Reconnect / pending-op resubmission (§3.5: replayPendingStates ->
+regeneratePendingOp, client.ts:972). Mirrors the reference's
+mocksForReconnection-based DDS tests."""
+import random
+
+import pytest
+
+from fluidframework_tpu.testing import FuzzConfig, MockCollabSession
+from fluidframework_tpu.testing.fuzz import random_op
+
+
+def make(n=2):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    return MockCollabSession(ids), ids
+
+
+def test_offline_edit_resubmitted_on_reconnect():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "base")
+    s.process_all()
+    s.disconnect("A")
+    s.do("A", "insert_text_local", 4, "-offline")  # stays pending
+    s.do("B", "insert_text_local", 0, "B:")
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    assert s.assert_converged() == "B:base-offline"
+
+
+def test_inflight_op_lost_on_disconnect_is_regenerated():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "hello")
+    s.process_all()
+    s.do("A", "insert_text_local", 5, " world")  # queued, not ticketed
+    s.disconnect("A")  # raw op dropped
+    s.do("B", "remove_range_local", 0, 1)
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    assert s.assert_converged() == "ello world"
+
+
+def test_pending_insert_then_remove_of_it_survives_reconnect():
+    """Code-review repro: a pending insert fully removed by a later
+    pending local remove must resubmit both ops (or neither's effects),
+    and the ack queue must stay aligned."""
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "keep")
+    s.process_all()
+    s.disconnect("A")
+    s.do("A", "insert_text_local", 4, "abc")
+    s.do("A", "remove_range_local", 4, 7)  # removes own pending insert
+    s.do("B", "insert_text_local", 4, "-B")
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    assert s.assert_converged() == "keepabc".replace("abc", "") + "-B" \
+        or s.assert_converged() in ("keep-B",)
+
+
+def test_remove_superseded_by_remote_remove_is_dropped():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "abcdef")
+    s.process_all()
+    s.disconnect("A")
+    s.do("A", "remove_range_local", 0, 3)   # pending remove, offline
+    s.do("B", "remove_range_local", 0, 3)   # remote remove, sequenced
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    assert s.assert_converged() == "def"
+
+
+def test_multiple_pending_removes_regenerate_in_order():
+    """Out-of-document-order pending removes must resolve via the
+    rebase view (localSeq-aware), not the plain local view."""
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "ABCD")
+    s.process_all()
+    s.disconnect("A")
+    s.do("A", "remove_range_local", 2, 3)  # remove 'C' first
+    s.do("A", "remove_range_local", 0, 1)  # then remove 'A'
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    assert s.assert_converged() == "BD"
+
+
+def test_annotate_resubmitted_after_reconnect():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "abcd")
+    s.process_all()
+    s.disconnect("A")
+    s.do("A", "annotate_range_local", 0, 2, {"bold": True})
+    s.do("B", "insert_text_local", 0, "xx")
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    s.assert_converged()
+    for cid in ("A", "B"):
+        tree = s.client(cid).mergetree
+        annotated = [
+            seg.text for seg in tree.segments
+            if not seg.removed and (seg.props or {}).get("bold")
+        ]
+        assert "".join(annotated) == "ab", cid
+
+
+def test_double_reconnect():
+    s, _ = make(2)
+    s.do("A", "insert_text_local", 0, "base")
+    s.process_all()
+    s.disconnect("A")
+    s.do("A", "insert_text_local", 0, "x")
+    s.reconnect("A")
+    s.disconnect("A")  # drops the just-resubmitted raw op again
+    s.do("A", "insert_text_local", 0, "y")
+    s.do("B", "insert_text_local", 4, "!")
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    text = s.assert_converged()
+    assert sorted(text) == sorted("basexy!")
+    assert text.endswith("!") or "!" in text
+
+
+@pytest.mark.parametrize("seed", range(15))
+def test_reconnect_fuzz(seed):
+    """Random ops + random disconnect/reconnect churn, must converge."""
+    rng = random.Random(seed + 4242)
+    ids = ["A", "B", "C"]
+    s = MockCollabSession(ids)
+    cfg = FuzzConfig()
+    down: set[str] = set()
+    for step in range(150):
+        r = rng.random()
+        if r < 0.05 and len(down) < len(ids) - 1:
+            cid = rng.choice([c for c in ids if c not in down])
+            s.disconnect(cid)
+            down.add(cid)
+        elif r < 0.12 and down:
+            cid = rng.choice(sorted(down))
+            s.reconnect(cid)
+            down.remove(cid)
+        elif r < 0.30 and s.pending_count:
+            s.process_some(rng.randint(1, s.pending_count))
+        else:
+            random_op(rng, s, rng.choice(ids), cfg)
+    for cid in sorted(down):
+        s.reconnect(cid)
+    s.process_all()
+    s.assert_converged()
