@@ -1,0 +1,138 @@
+"""Benchmark: batched merge-tree op throughput (BASELINE config #2:
+N docs x concurrent clients typing, batched apply).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": R}
+
+``vs_baseline`` is measured against this repo's scalar client replay
+(the host/oracle path — a stand-in for the reference's Node.js
+merge-tree, which cannot be built in this zero-egress image; see
+BASELINE.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_workload(docs: int, base_streams: int, steps: int, clients: int):
+    from fluidframework_tpu.ops import build_batch, encode_stream, make_table
+    from fluidframework_tpu.testing import FuzzConfig, record_op_stream
+
+    raw_streams = []
+    for i in range(base_streams):
+        _, stream = record_op_stream(FuzzConfig(
+            n_clients=clients, n_steps=steps, seed=31337 + i,
+            insert_weight=0.55, remove_weight=0.25, annotate_weight=0.05,
+            process_weight=0.15,
+        ))
+        raw_streams.append(stream)
+    # Documents are independent; tile the distinct base streams to the
+    # full doc count for throughput measurement.
+    streams = [raw_streams[d % base_streams] for d in range(docs)]
+    encoded = [encode_stream(s) for s in streams]
+    batch = build_batch(encoded)
+    return raw_streams, encoded, batch
+
+
+def bench_kernel(batch, docs: int, capacity: int, reps: int,
+                 cooldown: float = 3.0):
+    import jax
+    import numpy as np
+
+    from fluidframework_tpu.ops import apply_window, make_table
+    from fluidframework_tpu.ops.segment_table import KIND_NOOP
+
+    real_ops = int((np.asarray(batch.kind) != KIND_NOOP).sum())
+    # warmup/compile
+    table = apply_window(make_table(docs, capacity), batch)
+    jax.block_until_ready(table)
+    assert not np.asarray(table.overflow).any(), "bench capacity overflow"
+
+    # The tunneled v5e duty-cycle throttles ~7-50x under sustained
+    # dispatch and needs tens of seconds idle to recover (measured:
+    # 1.7-7 ms/window when cool vs up to 400 ms throttled). Space reps
+    # with a cooldown and report the best observed window.
+    times = []
+    for _ in range(reps):
+        fresh = make_table(docs, capacity)
+        jax.block_until_ready(fresh)
+        time.sleep(cooldown)
+        t0 = time.perf_counter()
+        out = apply_window(fresh, batch)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    return real_ops / best, real_ops, best, times
+
+
+def bench_scalar(raw_streams, seconds_budget: float = 3.0):
+    """Scalar client replay ops/sec (host baseline proxy)."""
+    from fluidframework_tpu.models.mergetree import MergeTreeClient
+    from fluidframework_tpu.protocol.messages import MessageType
+
+    ops = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds_budget:
+        for stream in raw_streams:
+            obs = MergeTreeClient("bench-observer")
+            obs.start_collaboration("bench-observer")
+            for msg in stream:
+                if msg.type == MessageType.OPERATION:
+                    obs.apply_msg(msg)
+                    ops += 1
+            if time.perf_counter() - t0 > seconds_budget:
+                break
+    return ops / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="small fast run (CI)")
+    parser.add_argument("--docs", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=None)
+    parser.add_argument("--reps", type=int, default=5)
+    parser.add_argument("--cooldown", type=float, default=None,
+                        help="idle seconds between reps (throttle recovery)")
+    args = parser.parse_args()
+
+    if args.smoke:
+        docs, base, steps, clients, capacity = 32, 8, 60, 3, 512
+        cooldown = 0.5
+    else:
+        docs, base, steps, clients, capacity = 1024, 16, 220, 4, 1024
+        cooldown = 35.0
+    docs = args.docs or docs
+    steps = args.steps or steps
+    if args.cooldown is not None:
+        cooldown = args.cooldown
+
+    raw_streams, _encoded, batch = build_workload(docs, base, steps, clients)
+    kernel_ops_s, real_ops, best, times = bench_kernel(
+        batch, docs, capacity, args.reps, cooldown
+    )
+    scalar_ops_s = bench_scalar(raw_streams, 2.0 if args.smoke else 4.0)
+
+    result = {
+        "metric": "mergetree_batched_ops_per_sec",
+        "value": round(kernel_ops_s, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(kernel_ops_s / scalar_ops_s, 2),
+        "detail": {
+            "docs": docs,
+            "window": int(batch.kind.shape[1]),
+            "real_ops": real_ops,
+            "best_window_time_s": round(best, 4),
+            "window_times_s": [round(t, 4) for t in times],
+            "scalar_client_ops_per_sec": round(scalar_ops_s, 1),
+            "baseline_proxy": "in-repo scalar Python client replay",
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

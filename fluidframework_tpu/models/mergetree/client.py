@@ -32,6 +32,9 @@ class SegmentGroup:
     local_seq: int
     kind: DeltaType
     segments: list[Segment] = field(default_factory=list)
+    # original op props, preserved across regenerations (a regenerated
+    # GroupOp has no top-level props)
+    props: Optional[dict] = None
 
 
 class MergeTreeClient:
@@ -101,7 +104,10 @@ class MergeTreeClient:
             self._apply_op(op, collab.current_seq, self._local_id, 0)
             return
         collab.local_seq += 1
-        group = SegmentGroup(op=op, local_seq=collab.local_seq, kind=op.type)
+        group = SegmentGroup(
+            op=op, local_seq=collab.local_seq, kind=op.type,
+            props=getattr(op, "props", None),
+        )
         segs = self._apply_op(
             op, collab.current_seq, self._local_id, UNASSIGNED_SEQ,
             local_seq=collab.local_seq,
@@ -183,11 +189,7 @@ class MergeTreeClient:
                 seg.local_removed_seq = None
             seg.groups = [g for g in seg.groups if g is not group]
         if group.kind == DeltaType.ANNOTATE:
-            props = (
-                group.op.props if group.op.type == DeltaType.ANNOTATE
-                else {k: v for sub in group.op.ops for k, v in sub.props.items()}
-            )
-            self.mergetree.ack_annotate(group.segments, props)
+            self.mergetree.ack_annotate(group.segments, group.props or {})
 
     # ------------------------------------------------------------------
     # reconnect (regeneratePendingOp, client.ts:972)
@@ -239,8 +241,7 @@ class MergeTreeClient:
                     )
                     sub_ops.append(InsertOp(
                         pos1=pos, text=seg.text,
-                        marker=seg.marker, props=group.op.props
-                        if hasattr(group.op, "props") else None,
+                        marker=seg.marker, props=group.props,
                     ))
                 elif group.kind == DeltaType.REMOVE:
                     if seg.removal_acked:
@@ -253,11 +254,7 @@ class MergeTreeClient:
                 elif group.kind == DeltaType.ANNOTATE:
                     if seg.removal_acked:
                         continue  # annotation on a gone segment is moot
-                    props = (
-                        group.op.props
-                        if group.op.type == DeltaType.ANNOTATE
-                        else group.op.ops[0].props
-                    )
+                    props = group.props or {}
                     pos = self.mergetree.get_offset(
                         seg, collab.current_seq, self._local_id,
                         local_seq=group.local_seq,

@@ -22,7 +22,7 @@ oracle for the batched TPU kernels in ``fluidframework_tpu.ops``.
 """
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
 from ...protocol.constants import MAX_SEQ, NON_COLLAB_CLIENT, UNASSIGNED_SEQ
 from .segments import CollabWindow, Segment
@@ -32,9 +32,6 @@ class MergeTree:
     def __init__(self) -> None:
         self.segments: list[Segment] = []
         self.collab = CollabWindow(client_id=NON_COLLAB_CLIENT)
-        # Called with the tail whenever a segment splits, so pending-op
-        # segment groups can track both halves (client.ts segment groups).
-        self.on_split: Optional[Callable[[Segment, Segment], None]] = None
 
     # ------------------------------------------------------------------
     # collaboration lifecycle
@@ -162,8 +159,6 @@ class MergeTree:
         seg = self.segments[index]
         tail = seg.split(offset)
         self.segments.insert(index + 1, tail)
-        if self.on_split is not None:
-            self.on_split(seg, tail)
 
     def _ensure_boundary(
         self, pos: int, refseq: int, client_id: int,

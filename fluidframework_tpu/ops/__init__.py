@@ -1,0 +1,47 @@
+"""Device kernels: batched merge, compaction, host bridge.
+
+The TPU compute path — no reference analogue; this is the north star
+(BASELINE.json): vectorized conflict resolution across documents.
+"""
+from .host_bridge import (
+    DocStream,
+    build_batch,
+    encode_stream,
+    extract_signature,
+    extract_text,
+    fetch,
+)
+from .merge_kernel import apply_window, compact
+from .segment_table import (
+    KIND_ANNOTATE,
+    KIND_INSERT,
+    KIND_NOOP,
+    KIND_REMOVE,
+    MAX_CLIENTS,
+    NOT_REMOVED,
+    PROP_CHANNELS,
+    OpBatch,
+    SegmentTable,
+    make_table,
+)
+
+__all__ = [
+    "DocStream",
+    "OpBatch",
+    "SegmentTable",
+    "apply_window",
+    "build_batch",
+    "compact",
+    "encode_stream",
+    "extract_signature",
+    "extract_text",
+    "fetch",
+    "make_table",
+    "KIND_ANNOTATE",
+    "KIND_INSERT",
+    "KIND_NOOP",
+    "KIND_REMOVE",
+    "MAX_CLIENTS",
+    "NOT_REMOVED",
+    "PROP_CHANNELS",
+]

@@ -1,0 +1,184 @@
+"""Host <-> device bridge for the merge kernel.
+
+Encoding: turns sequenced message streams (SequencedMessage with
+merge-tree op contents) into padded ``OpBatch`` tensors; text payloads
+stay host-side keyed by op_id (SURVEY §7: the device resolves
+positions, the host splices text).
+
+Extraction: materializes text / property signatures from a fetched
+segment table.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..models.mergetree.ops import DeltaType
+from ..protocol.messages import MessageType, SequencedMessage
+from .segment_table import (
+    KIND_ANNOTATE,
+    KIND_INSERT,
+    KIND_NOOP,
+    KIND_REMOVE,
+    NOT_REMOVED,
+    OpBatch,
+    PROP_CHANNELS,
+    SegmentTable,
+)
+
+OP_FIELDS = (
+    "kind", "pos1", "pos2", "seq", "refseq", "client",
+    "op_id", "length", "is_marker", "prop_key", "prop_val", "min_seq",
+)
+
+
+@dataclass
+class DocStream:
+    """One document's encoded op stream + payload table."""
+
+    ops: list[dict] = field(default_factory=list)
+    payloads: list[str] = field(default_factory=list)
+    client_ids: dict[str, int] = field(default_factory=dict)
+    prop_keys: dict[str, int] = field(default_factory=dict)
+    prop_vals: dict[Any, int] = field(default_factory=dict)
+
+    def intern_client(self, long_id: str) -> int:
+        if long_id not in self.client_ids:
+            self.client_ids[long_id] = len(self.client_ids)
+        return self.client_ids[long_id]
+
+    def intern_prop(self, key: str, value: Any) -> tuple[int, int]:
+        if key not in self.prop_keys:
+            if len(self.prop_keys) >= PROP_CHANNELS:
+                raise ValueError(
+                    f"more than {PROP_CHANNELS} property channels"
+                )
+            self.prop_keys[key] = len(self.prop_keys)
+        if value is None:
+            vid = 0  # deletion
+        else:
+            if value not in self.prop_vals:
+                self.prop_vals[value] = len(self.prop_vals) + 1
+            vid = self.prop_vals[value]
+        return self.prop_keys[key], vid
+
+    def add_message(self, msg: SequencedMessage) -> None:
+        if msg.type != MessageType.OPERATION:
+            self.add_noop(msg.minimum_sequence_number)
+            return
+        self._add_op(msg.contents, msg)
+
+    def add_noop(self, min_seq: int) -> None:
+        self.ops.append(dict(
+            kind=KIND_NOOP, pos1=0, pos2=0, seq=0, refseq=0, client=0,
+            op_id=0, length=0, is_marker=0, prop_key=0, prop_val=0,
+            min_seq=min_seq,
+        ))
+
+    def _add_op(self, op, msg: SequencedMessage) -> None:
+        base = dict(
+            seq=msg.sequence_number,
+            refseq=msg.reference_sequence_number,
+            client=self.intern_client(msg.client_id),
+            min_seq=msg.minimum_sequence_number,
+            op_id=0, length=0, is_marker=0,
+            prop_key=0, prop_val=0, pos2=0,
+        )
+        if op.type == DeltaType.GROUP:
+            for sub in op.ops:
+                self._add_op(sub, msg)
+            return
+        if op.type == DeltaType.INSERT:
+            is_marker = op.text is None
+            payload = "" if is_marker else op.text
+            self.ops.append(dict(
+                base, kind=KIND_INSERT, pos1=op.pos1,
+                op_id=len(self.payloads),
+                length=1 if is_marker else len(payload),
+                is_marker=int(is_marker),
+            ))
+            self.payloads.append(payload)
+        elif op.type == DeltaType.REMOVE:
+            self.ops.append(dict(
+                base, kind=KIND_REMOVE, pos1=op.pos1, pos2=op.pos2,
+            ))
+        elif op.type == DeltaType.ANNOTATE:
+            for key, value in op.props.items():
+                k, v = self.intern_prop(key, value)
+                self.ops.append(dict(
+                    base, kind=KIND_ANNOTATE, pos1=op.pos1, pos2=op.pos2,
+                    prop_key=k, prop_val=v,
+                ))
+        else:
+            raise ValueError(f"unknown op type {op.type}")
+
+
+def encode_stream(messages: list[SequencedMessage]) -> DocStream:
+    stream = DocStream()
+    for msg in messages:
+        stream.add_message(msg)
+    return stream
+
+
+def build_batch(streams: list[DocStream],
+                window: Optional[int] = None) -> OpBatch:
+    """Pack per-doc streams into [docs, window] OpBatch arrays, padded
+    with NOOPs."""
+    window = window or max(len(s.ops) for s in streams)
+    docs = len(streams)
+    arrays = {f: np.zeros((docs, window), np.int32) for f in OP_FIELDS}
+    arrays["kind"][:] = KIND_NOOP
+    for d, stream in enumerate(streams):
+        if len(stream.ops) > window:
+            raise ValueError(
+                f"doc {d}: {len(stream.ops)} ops exceed window {window}"
+            )
+        for w, op in enumerate(stream.ops):
+            for f in OP_FIELDS:
+                arrays[f][d, w] = op[f]
+    return OpBatch(**arrays)
+
+
+def fetch(table: SegmentTable) -> dict[str, np.ndarray]:
+    return {f: np.asarray(getattr(table, f)) for f in table._fields}
+
+
+def extract_text(table_np: dict[str, np.ndarray], stream: DocStream,
+                 doc: int) -> str:
+    """Tip-view text of one document (removed slots excluded, markers
+    skipped)."""
+    parts = []
+    count = int(table_np["count"][doc])
+    for i in range(count):
+        if table_np["removed_seq"][doc, i] != NOT_REMOVED:
+            continue
+        if table_np["is_marker"][doc, i]:
+            continue
+        op_id = int(table_np["op_id"][doc, i])
+        off = int(table_np["op_off"][doc, i])
+        length = int(table_np["length"][doc, i])
+        parts.append(stream.payloads[op_id][off:off + length])
+    return "".join(parts)
+
+
+def extract_signature(table_np: dict[str, np.ndarray], stream: DocStream,
+                      doc: int) -> tuple:
+    """Per-position (char, interned-props) signature for differential
+    comparison with the scalar oracle."""
+    out = []
+    count = int(table_np["count"][doc])
+    for i in range(count):
+        if table_np["removed_seq"][doc, i] != NOT_REMOVED:
+            continue
+        props = tuple(int(v) for v in table_np["prop"][doc, i])
+        if table_np["is_marker"][doc, i]:
+            out.append(("M", props))
+            continue
+        op_id = int(table_np["op_id"][doc, i])
+        off = int(table_np["op_off"][doc, i])
+        length = int(table_np["length"][doc, i])
+        for ch in stream.payloads[op_id][off:off + length]:
+            out.append((ch, props))
+    return tuple(out)

@@ -1,0 +1,110 @@
+"""Struct-of-arrays segment table — the device-side merge-tree state.
+
+The TPU redesign of the reference's pointer B-tree
+(packages/dds/merge-tree/src/mergeTreeNodes.ts): one document is a
+fixed-capacity slab of segment slots in document order; a batch is
+``[docs, capacity]`` arrays, vmapped/sharded over the doc axis (the
+reference's Kafka-partition axis, SURVEY §2.9).
+
+Slots ``[0, count)`` are live; suffix slots are garbage. Text payloads
+never enter device memory: each slot carries ``(op_id, op_off,
+length)`` provenance and the host slices insert-op payloads to
+materialize text (SURVEY §7 "payload handling").
+
+Property state is ``prop[docs, capacity, PROP_CHANNELS]``: a fixed set
+of int32 property channels (key-interned), LWW in sequenced order —
+the sequenced-path reduction of segmentPropertiesManager.ts (no
+pendings exist server-side). 0 means unset/deleted.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# "never removed" sentinel: all real seqs compare below it.
+NOT_REMOVED = np.int32(2**31 - 1)
+
+# Fixed number of interned property channels per document.
+PROP_CHANNELS = 4
+
+# Max clients per document (removers bitmask width).
+MAX_CLIENTS = 32
+
+
+class SegmentTable(NamedTuple):
+    """Batched segment state, all arrays [docs, capacity] int32 unless
+    noted."""
+
+    length: jnp.ndarray       # payload length (chars); markers use 1
+    seq: jnp.ndarray          # insert sequence number
+    client: jnp.ndarray       # interned inserter id (0..MAX_CLIENTS-1)
+    removed_seq: jnp.ndarray  # NOT_REMOVED if alive
+    removers: jnp.ndarray     # uint32 bitmask of removing clients
+    op_id: jnp.ndarray        # payload provenance: insert op index
+    op_off: jnp.ndarray       # offset within that op's payload
+    is_marker: jnp.ndarray    # 1 if marker (excluded from text)
+    prop: jnp.ndarray         # [docs, capacity, PROP_CHANNELS]
+    count: jnp.ndarray        # [docs] live slot count
+    min_seq: jnp.ndarray      # [docs] collab window floor
+    overflow: jnp.ndarray     # [docs] 1 if capacity was exhausted
+
+    @property
+    def docs(self) -> int:
+        return self.length.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        # shape[-1] so per-doc views inside vmap also work
+        return self.length.shape[-1]
+
+
+def make_table(docs: int, capacity: int) -> SegmentTable:
+    shape = (docs, capacity)
+
+    def zeros():
+        # distinct buffers: apply_window donates the whole table, and
+        # aliased buffers cannot be donated twice
+        return jnp.zeros(shape, jnp.int32)
+
+    return SegmentTable(
+        length=zeros(),
+        seq=zeros(),
+        client=zeros(),
+        removed_seq=jnp.full(shape, NOT_REMOVED, jnp.int32),
+        removers=jnp.zeros(shape, jnp.uint32),
+        op_id=zeros(),
+        op_off=zeros(),
+        is_marker=zeros(),
+        prop=jnp.zeros((docs, capacity, PROP_CHANNELS), jnp.int32),
+        count=jnp.zeros((docs,), jnp.int32),
+        min_seq=jnp.zeros((docs,), jnp.int32),
+        overflow=jnp.zeros((docs,), jnp.int32),
+    )
+
+
+class OpBatch(NamedTuple):
+    """A padded window of sequenced ops, all arrays [docs, window]
+    int32. ``kind`` 3 (NOOP) pads docs with fewer ops. Numeric tensor
+    form of ISequencedDocumentMessage + merge-tree op contents
+    (protocol.ts:212, ops.ts)."""
+
+    kind: jnp.ndarray      # 0 INSERT / 1 REMOVE / 2 ANNOTATE / 3 NOOP
+    pos1: jnp.ndarray
+    pos2: jnp.ndarray      # REMOVE/ANNOTATE end (exclusive)
+    seq: jnp.ndarray       # sequence number
+    refseq: jnp.ndarray    # reference sequence number
+    client: jnp.ndarray    # interned sender
+    op_id: jnp.ndarray     # INSERT payload index
+    length: jnp.ndarray    # INSERT payload length
+    is_marker: jnp.ndarray
+    prop_key: jnp.ndarray  # ANNOTATE channel (0..PROP_CHANNELS-1)
+    prop_val: jnp.ndarray  # ANNOTATE value (0 deletes)
+    min_seq: jnp.ndarray   # msn stamp (advances the collab window)
+
+
+KIND_INSERT = 0
+KIND_REMOVE = 1
+KIND_ANNOTATE = 2
+KIND_NOOP = 3

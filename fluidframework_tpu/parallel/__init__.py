@@ -1,0 +1,17 @@
+"""Sharding & dispatch: document-parallel distribution over a device
+mesh (the reference's Kafka-partition axis, SURVEY §2.9)."""
+from .mesh import (
+    DOC_AXIS,
+    doc_sharding,
+    make_mesh,
+    scalar_sharding,
+    shard_pytree,
+)
+
+__all__ = [
+    "DOC_AXIS",
+    "doc_sharding",
+    "make_mesh",
+    "scalar_sharding",
+    "shard_pytree",
+]

@@ -1,0 +1,38 @@
+"""Device mesh + sharding for the batched merge state.
+
+The distribution axis is documents (SURVEY §2.9: the reference's total
+order is per-document; docs shard statelessly over Kafka partitions —
+here over a ``jax.sharding.Mesh`` doc axis). Segment tables and op
+batches shard on dim 0; within a document the op window is a dependent
+scan, so no intra-doc sharding is needed until the long-document
+sequence-parallel path (SURVEY §5.7) lands.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DOC_AXIS = "docs"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (DOC_AXIS,))
+
+
+def doc_sharding(mesh: Mesh) -> NamedSharding:
+    """Dim-0 (document) sharding for tables and op batches."""
+    return NamedSharding(mesh, P(DOC_AXIS))
+
+
+def scalar_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_pytree(tree, mesh: Mesh):
+    """Place every leaf with dim 0 = docs on the doc axis."""
+    sharding = doc_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)

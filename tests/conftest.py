@@ -11,3 +11,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The image's sitecustomize registers the axon TPU plugin and pins
+# JAX_PLATFORMS=axon before this file runs; the config update below is
+# what actually wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

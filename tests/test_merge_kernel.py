@@ -1,0 +1,150 @@
+"""Differential tests: batched merge kernel vs scalar oracle on
+identical sequenced streams (SURVEY §4, pillar (d)).
+
+Every fuzz stream is applied both by a fresh oracle client (pure remote
+apply) and by the kernel; final text and per-position property
+signatures must match exactly.
+"""
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.mergetree import MergeTreeClient
+from fluidframework_tpu.ops import (
+    NOT_REMOVED,
+    apply_window,
+    build_batch,
+    compact,
+    encode_stream,
+    extract_signature,
+    extract_text,
+    fetch,
+    make_table,
+)
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.testing import FuzzConfig, record_op_stream
+
+
+def oracle_replay(stream):
+    """Fresh observer client applying the whole sequenced stream."""
+    obs = MergeTreeClient("kernel-observer")
+    obs.start_collaboration("kernel-observer")
+    for msg in stream:
+        if msg.type == MessageType.OPERATION:
+            obs.apply_msg(msg)
+    return obs
+
+
+def oracle_signature(obs, enc):
+    """Observer's visible content with properties interned the same way
+    the encoder interned them for the kernel."""
+    tree = obs.mergetree
+    out = []
+    for seg in tree.segments:
+        length = tree._length_at(
+            seg, tree.collab.current_seq, tree.collab.client_id
+        )
+        if not length:
+            continue
+        props = [0] * 4
+        for key, value in (seg.props or {}).items():
+            if key in enc.prop_keys and value is not None:
+                props[enc.prop_keys[key]] = enc.prop_vals[value]
+        props = tuple(props)
+        if seg.is_marker:
+            out.append(("M", props))
+        else:
+            out.extend((ch, props) for ch in seg.text)
+    return tuple(out)
+
+
+def run_kernel(streams, capacity=512):
+    encs = [encode_stream(s) for s in streams]
+    batch = build_batch(encs)
+    table = make_table(len(encs), capacity)
+    table = apply_window(table, batch)
+    np_table = fetch(table)
+    assert not np_table["overflow"].any(), "capacity overflow"
+    return encs, np_table
+
+
+def test_kernel_basic_insert_remove():
+    from fluidframework_tpu.testing import MockCollabSession
+
+    stream = []
+    s = MockCollabSession(["A"], stream_log=stream)
+    s.do("A", "insert_text_local", 0, "hello world")
+    s.do("A", "remove_range_local", 5, 11)
+    s.do("A", "insert_text_local", 5, "!")
+    s.process_all()
+    encs, np_table = run_kernel([stream])
+    assert extract_text(np_table, encs[0], 0) == "hello!"
+
+
+def test_kernel_concurrent_inserts_tiebreak():
+    from fluidframework_tpu.testing import MockCollabSession
+
+    stream = []
+    s = MockCollabSession(["A", "B"], stream_log=stream)
+    s.do("A", "insert_text_local", 0, "aaa")
+    s.do("B", "insert_text_local", 0, "bbb")
+    s.process_all()
+    assert s.assert_converged() == "bbbaaa"
+    encs, np_table = run_kernel([stream])
+    assert extract_text(np_table, encs[0], 0) == "bbbaaa"
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_kernel_differential_fuzz(seed):
+    text, stream = record_op_stream(FuzzConfig(
+        n_clients=3, n_steps=120, seed=seed * 31 + 7,
+        remove_weight=0.3, annotate_weight=0.15,
+    ))
+    encs, np_table = run_kernel([stream])
+    assert extract_text(np_table, encs[0], 0) == text
+    obs = oracle_replay(stream)
+    assert extract_signature(np_table, encs[0], 0) == oracle_signature(
+        obs, encs[0]
+    )
+
+
+def test_kernel_multidoc_batch():
+    """Independent docs, one dispatch, padded window."""
+    cases = [
+        record_op_stream(FuzzConfig(n_clients=3, n_steps=80,
+                                    seed=900 + i))
+        for i in range(8)
+    ]
+    streams = [stream for _, stream in cases]
+    encs, np_table = run_kernel(streams)
+    for d, (text, _) in enumerate(cases):
+        assert extract_text(np_table, encs[d], d) == text, f"doc {d}"
+
+
+def test_kernel_compaction_preserves_content():
+    text, stream = record_op_stream(FuzzConfig(
+        n_clients=3, n_steps=150, seed=77, remove_weight=0.4,
+    ))
+    encs = [encode_stream(stream)]
+    batch = build_batch(encs)
+    table = make_table(1, 512)
+    table = apply_window(table, batch)
+    before = fetch(table)
+    table = compact(table)
+    after = fetch(table)
+    assert extract_text(after, encs[0], 0) == text
+    assert int(after["count"][0]) <= int(before["count"][0])
+    # everything below the window is gone
+    cnt = int(after["count"][0])
+    removed = after["removed_seq"][0, :cnt]
+    assert not ((removed != NOT_REMOVED)
+                & (removed <= int(after["min_seq"][0]))).any()
+
+
+def test_kernel_overflow_flag():
+    text, stream = record_op_stream(FuzzConfig(n_clients=2, n_steps=60,
+                                               seed=5))
+    encs = [encode_stream(stream)]
+    batch = build_batch(encs)
+    table = make_table(1, 8)  # deliberately tiny
+    table = apply_window(table, batch)
+    assert int(fetch(table)["overflow"][0]) == 1
