@@ -2,3 +2,31 @@
 
 Reference analogue: packages/dds/*.
 """
+from ..runtime.shared_object import ChannelRegistry, simple_factory
+from .cell import SharedCell
+from .counter import SharedCounter
+from .map import MapKernel, SharedDirectory, SharedMap
+from .sharedstring import SharedString
+
+
+def default_registry() -> ChannelRegistry:
+    """Registry with every built-in channel type (the IChannelFactory
+    catalogue)."""
+    return ChannelRegistry([
+        simple_factory(SharedString),
+        simple_factory(SharedMap),
+        simple_factory(SharedDirectory),
+        simple_factory(SharedCell),
+        simple_factory(SharedCounter),
+    ])
+
+
+__all__ = [
+    "MapKernel",
+    "SharedCell",
+    "SharedCounter",
+    "SharedDirectory",
+    "SharedMap",
+    "SharedString",
+    "default_registry",
+]

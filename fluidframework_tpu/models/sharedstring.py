@@ -1,0 +1,156 @@
+"""SharedString: collaborative rich text over the merge-tree client.
+
+Reference: packages/dds/sequence/src/sharedString.ts (:63) +
+sequence.ts (``SharedSegmentSequence`` :109). The channel is a thin
+facade: concurrency lives in ``MergeTreeClient``; this class adapts it
+to the SharedObject contract and summary format.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..protocol.constants import UNASSIGNED_SEQ
+from ..protocol.messages import SequencedMessage
+from ..runtime.shared_object import SharedObject
+from ..utils.events import EventEmitter
+from .mergetree import MergeTreeClient
+from .mergetree.segments import Segment
+
+
+class SharedString(SharedObject, EventEmitter):
+    type_name = "sharedstring"
+
+    def __init__(self, channel_id: str):
+        SharedObject.__init__(self, channel_id)
+        EventEmitter.__init__(self)
+        self.client = MergeTreeClient()
+        self._resubmit_epoch = -1
+
+    # ------------------------------------------------------------------
+
+    def _on_connect(self) -> None:
+        client_id = self.client_id
+        if not client_id:
+            return  # container identity not known yet
+        if not self.client.mergetree.collab.collaborating:
+            self.client.start_collaboration(client_id)
+        else:
+            self.client.long_client_id = client_id
+
+    # ------------------------------------------------------------------
+    # public editing API (sharedString.ts surface)
+
+    def insert_text(self, pos: int, text: str,
+                    props: Optional[dict] = None) -> None:
+        op = self.client.insert_text_local(pos, text, props)
+        self.submit_local_message(op)
+
+    def insert_marker(self, pos: int, ref_type: int,
+                      props: Optional[dict] = None) -> None:
+        op = self.client.insert_marker_local(pos, ref_type, props)
+        self.submit_local_message(op)
+
+    def remove_text(self, start: int, end: int) -> None:
+        op = self.client.remove_range_local(start, end)
+        self.submit_local_message(op)
+
+    def annotate_range(self, start: int, end: int, props: dict) -> None:
+        op = self.client.annotate_range_local(start, end, props)
+        self.submit_local_message(op)
+
+    def get_text(self) -> str:
+        return self.client.get_text()
+
+    def get_length(self) -> int:
+        return self.client.get_length()
+
+    # ------------------------------------------------------------------
+    # SharedObject contract
+
+    def process_core(self, msg: SequencedMessage, local: bool,
+                     local_op_metadata: Any = None) -> None:
+        assert local == (msg.client_id == self.client.long_client_id)
+        self.client.apply_msg(msg)
+        self.emit("sequenceDelta", msg, local)
+
+    def resubmit_core(self, contents: Any, metadata: Any = None) -> None:
+        """Reconnect rebase (client.ts regeneratePendingOp via
+        reSubmitCore). The merge-tree client owns the whole pending
+        queue, so the first replayed op of an epoch regenerates and
+        resubmits everything; later replays of the same epoch no-op."""
+        epoch = getattr(self._services, "reconnect_epoch", None)
+        if epoch is not None and epoch == self._resubmit_epoch:
+            return
+        self._resubmit_epoch = epoch if epoch is not None else (
+            self._resubmit_epoch - 1
+        )
+        for op in self.client.regenerate_pending_ops():
+            self.submit_local_message(op)
+
+    def signature(self):
+        """Per-position (char|marker, props) content signature."""
+        tree = self.client.mergetree
+        out = []
+        for seg in tree.segments:
+            length = tree._length_at(
+                seg, tree.collab.current_seq, tree.collab.client_id
+            )
+            if not length:
+                continue
+            props = tuple(sorted((seg.props or {}).items()))
+            if seg.is_marker:
+                out.append(("M", seg.marker["refType"], props))
+            else:
+                out.extend((ch, props) for ch in seg.text)
+        return tuple(out)
+
+    # ------------------------------------------------------------------
+    # summary (SnapshotV1 simplified: snapshotV1.ts:36)
+
+    def summarize_core(self) -> dict:
+        tree = self.client.mergetree
+        assert not self.client._pending, (
+            "summarize with pending local ops (the summarizer client "
+            "must be quiescent)"
+        )
+        segments = []
+        for seg in tree.segments:
+            segments.append({
+                "text": seg.text,
+                "marker": seg.marker,
+                "seq": seg.seq,
+                "client": self.client._short_to_long[seg.client_id]
+                if 0 <= seg.client_id < len(self.client._short_to_long)
+                else "",
+                "removedSeq": seg.removed_seq,
+                "removedClients": [
+                    self.client._short_to_long[c]
+                    for c in seg.removed_client_ids
+                    if 0 <= c < len(self.client._short_to_long)
+                ],
+                "props": seg.props,
+            })
+        return {
+            "segments": segments,
+            "minSeq": tree.collab.min_seq,
+            "currentSeq": tree.collab.current_seq,
+        }
+
+    def load_core(self, summary: dict) -> None:
+        tree = self.client.mergetree
+        assert not tree.segments, "load into non-empty string"
+        tree.collab.min_seq = summary["minSeq"]
+        tree.collab.current_seq = summary["currentSeq"]
+        for entry in summary["segments"]:
+            seg = Segment(
+                text=entry["text"],
+                marker=entry["marker"],
+                seq=entry["seq"],
+                client_id=self.client.intern(entry["client"]),
+                removed_seq=entry["removedSeq"],
+                removed_client_ids=[
+                    self.client.intern(c) for c in entry["removedClients"]
+                ],
+                props=dict(entry["props"]) if entry["props"] else None,
+            )
+            tree.segments.append(seg)

@@ -1,0 +1,23 @@
+"""Runtime layers: container/datastore orchestration, op lifecycle,
+pending state, channel plugin boundary.
+
+Reference analogue: packages/runtime/*, packages/loader.
+"""
+from .container_runtime import ContainerRuntime, PendingStateManager
+from .datastore import DataStoreRuntime
+from .shared_object import (
+    ChannelFactory,
+    ChannelRegistry,
+    SharedObject,
+    simple_factory,
+)
+
+__all__ = [
+    "ChannelFactory",
+    "ChannelRegistry",
+    "ContainerRuntime",
+    "DataStoreRuntime",
+    "PendingStateManager",
+    "SharedObject",
+    "simple_factory",
+]

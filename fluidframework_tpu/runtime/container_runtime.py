@@ -1,0 +1,199 @@
+"""Container runtime: datastore management, outbound batching, pending
+state, reconnect replay.
+
+Reference: packages/runtime/container-runtime/src/containerRuntime.ts
+(``ContainerRuntime`` :631; inbound ``process`` :1701; outbound
+``submitDataStoreOp`` :2549 -> ``Outbox``/``BatchManager``
+(opLifecycle/outbox.ts:35, batchManager.ts:22); ``flush`` :1852;
+``replayPendingStates`` :1573 with ``PendingStateManager``
+(pendingStateManager.ts:75); ``orderSequentially`` :1860).
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ..protocol.messages import SequencedMessage
+from ..utils.events import EventEmitter
+from .datastore import DataStoreRuntime
+from .shared_object import ChannelRegistry
+
+
+@dataclass
+class PendingOp:
+    """One locally-submitted op awaiting its ack
+    (pendingStateManager.ts pending message)."""
+
+    datastore_id: str
+    channel_id: str
+    contents: Any
+    metadata: Any
+
+
+class PendingStateManager:
+    """Exactly-once resubmit across reconnects
+    (pendingStateManager.ts:75): a deque of pending ops; acks pop the
+    head; on reconnect every entry replays through its channel's
+    ``resubmit_core`` (the rebase hook)."""
+
+    def __init__(self) -> None:
+        self._pending: deque[PendingOp] = deque()
+
+    def on_submit(self, op: PendingOp) -> None:
+        self._pending.append(op)
+
+    def on_local_ack(self, msg: SequencedMessage) -> PendingOp:
+        assert self._pending, "ack with no pending ops"
+        return self._pending.popleft()
+
+    def drain(self) -> list[PendingOp]:
+        out = list(self._pending)
+        self._pending.clear()
+        return out
+
+    @property
+    def count(self) -> int:
+        return len(self._pending)
+
+
+class ContainerRuntime(EventEmitter):
+    """One client's container: datastores + op lifecycle.
+
+    The host (loader/driver/test session) wires ``submit_fn`` — called
+    with the container-level op contents for each outbound message —
+    and feeds inbound sequenced messages to ``process``.
+    """
+
+    def __init__(self, registry: ChannelRegistry,
+                 submit_fn: Optional[Callable[[Any, Any], None]] = None):
+        super().__init__()
+        self.registry = registry
+        self._submit_fn = submit_fn
+        self.datastores: dict[str, DataStoreRuntime] = {}
+        self.pending = PendingStateManager()
+        self._outbox: list[PendingOp] = []
+        self.client_id: str = ""
+        self.connected = False
+        self.reconnect_epoch = 0  # bumped on every reconnect
+
+    # ------------------------------------------------------------------
+    # wiring
+
+    def set_submit_fn(self, fn: Callable[[Any, Any], None]) -> None:
+        self._submit_fn = fn
+
+    def set_connection_state(self, connected: bool,
+                             client_id: str = "") -> None:
+        """containerRuntime.ts:1307 setConnectionState; on reconnect,
+        replay pending states (:1573)."""
+        was_connected = self.connected
+        self.connected = connected
+        if client_id:
+            self.client_id = client_id
+        if connected:
+            # (re-)announce identity to every channel — channels created
+            # by load() connected before the client id was known
+            for ds in self.datastores.values():
+                for channel in ds.channels.values():
+                    channel._on_connect()
+        if connected and not was_connected and self.pending.count:
+            self._replay_pending()
+        self.emit("connected" if connected else "disconnected")
+
+    # ------------------------------------------------------------------
+    # datastores
+
+    def create_datastore(self, datastore_id: str) -> DataStoreRuntime:
+        if datastore_id in self.datastores:
+            raise ValueError(f"datastore {datastore_id!r} exists")
+        ds = DataStoreRuntime(self, datastore_id, self.registry)
+        self.datastores[datastore_id] = ds
+        return ds
+
+    def get_datastore(self, datastore_id: str) -> DataStoreRuntime:
+        return self.datastores[datastore_id]
+
+    # ------------------------------------------------------------------
+    # outbound (submitDataStoreOp :2549 -> Outbox -> flush :1852)
+
+    def submit_op(self, datastore_id: str, channel_id: str, contents: Any,
+                  metadata: Any = None) -> None:
+        op = PendingOp(datastore_id, channel_id, contents, metadata)
+        self._outbox.append(op)
+
+    def flush(self) -> int:
+        """Send every batched op (outbox.ts:102). Returns count sent."""
+        sent = 0
+        for op in self._outbox:
+            self.pending.on_submit(op)
+            if self._submit_fn is not None:
+                self._submit_fn(
+                    {
+                        "address": op.datastore_id,
+                        "channel": op.channel_id,
+                        "contents": op.contents,
+                    },
+                    op.metadata,
+                )
+            sent += 1
+        self._outbox.clear()
+        return sent
+
+    def order_sequentially(self, callback: Callable[[], None]) -> None:
+        """containerRuntime.ts:1860: run ``callback``, then flush its
+        ops as one batch."""
+        callback()
+        self.flush()
+
+    # ------------------------------------------------------------------
+    # inbound (process :1701)
+
+    def process(self, msg: SequencedMessage) -> None:
+        envelope = msg.contents
+        # Own ops are acks even when they arrive during catch-up while
+        # reconnecting (the connection flag is down but the op is ours).
+        local = bool(self.client_id) and msg.client_id == self.client_id
+        local_metadata = None
+        if local:
+            pending_op = self.pending.on_local_ack(msg)
+            local_metadata = pending_op.metadata
+        ds = self.datastores[envelope["address"]]
+        ds.process(
+            msg, envelope["channel"], envelope["contents"], local,
+            local_metadata,
+        )
+        self.emit("op", msg, local)
+
+    # ------------------------------------------------------------------
+    # reconnect (replayPendingStates :1573)
+
+    def _replay_pending(self) -> None:
+        self.reconnect_epoch += 1
+        for op in self.pending.drain():
+            channel = self.datastores[op.datastore_id].channels[
+                op.channel_id
+            ]
+            channel.resubmit_core(op.contents, op.metadata)
+        self.flush()
+
+    # ------------------------------------------------------------------
+    # summary (§3.4 client side)
+
+    def summarize(self) -> dict:
+        return {
+            "datastores": {
+                ds_id: ds.summarize()
+                for ds_id, ds in self.datastores.items()
+            }
+        }
+
+    def load(self, summary: dict) -> None:
+        for ds_id, ds_summary in summary.get("datastores", {}).items():
+            ds = self.create_datastore(ds_id)
+            ds.load(ds_summary)
+
+    @property
+    def is_dirty(self) -> bool:
+        """Unacked local state exists (containerRuntime dirty flag)."""
+        return bool(self._outbox) or self.pending.count > 0

@@ -1,0 +1,151 @@
+"""SharedObject base + channel plugin boundary.
+
+Reference: packages/dds/shared-object-base/src/sharedObject.ts
+(``SharedObjectCore`` :42 — abstract contract ``loadCore`` :305,
+``processCore`` :329, ``reSubmitCore`` :378, ``applyStashedOp`` :510,
+``summarizeCore``; submit path ``submitLocalMessage`` :343) and the
+``IChannelFactory`` registry (packages/runtime/datastore-definitions) —
+the plugin boundary the north star keeps: new channel types (including
+TPU-backed ones) register a factory, nothing else changes.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Optional, Protocol
+
+from ..protocol.messages import SequencedMessage
+
+
+class ChannelServices(Protocol):
+    """What a connected channel can do (IChannelServices): submit ops
+    into the container's outbox."""
+
+    def submit(self, contents: Any, metadata: Any = None) -> None: ...
+
+    @property
+    def client_id(self) -> str: ...
+
+    @property
+    def connected(self) -> bool: ...
+
+
+class SharedObject(abc.ABC):
+    """A distributed data structure instance (one channel)."""
+
+    # set by subclasses: the factory type name, e.g. "sharedstring"
+    type_name: str = ""
+
+    def __init__(self, channel_id: str):
+        self.id = channel_id
+        self._services: Optional[ChannelServices] = None
+
+    # ------------------------------------------------------------------
+    # wiring
+
+    @property
+    def connected(self) -> bool:
+        return self._services is not None and self._services.connected
+
+    @property
+    def client_id(self) -> Optional[str]:
+        return self._services.client_id if self._services else None
+
+    def connect(self, services: ChannelServices) -> None:
+        """Attach to a datastore runtime (sharedObject.ts connect)."""
+        self._services = services
+        self._on_connect()
+
+    def _on_connect(self) -> None:
+        """Hook for subclasses (start collaboration etc.)."""
+
+    def submit_local_message(self, contents: Any,
+                             metadata: Any = None) -> None:
+        """sharedObject.ts:343 — route a local op to the service via
+        the runtime; detached objects apply locally only."""
+        if self._services is not None:
+            self._services.submit(contents, metadata)
+
+    # ------------------------------------------------------------------
+    # the abstract DDS contract (sharedObject.ts:305-510)
+
+    @abc.abstractmethod
+    def process_core(self, msg: SequencedMessage, local: bool,
+                     local_op_metadata: Any = None) -> None:
+        """Apply one sequenced op. ``local`` means our own op came back
+        (ack), not a re-application."""
+
+    @abc.abstractmethod
+    def summarize_core(self) -> dict:
+        """Produce this channel's summary blob (JSON-safe)."""
+
+    @abc.abstractmethod
+    def load_core(self, summary: dict) -> None:
+        """Initialize state from a summary blob."""
+
+    def resubmit_core(self, contents: Any, metadata: Any = None) -> None:
+        """Rebase + resubmit a pending op after reconnect
+        (sharedObject.ts:378). Default: resubmit unchanged."""
+        self.submit_local_message(contents, metadata)
+
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Apply an op from stashed offline state (sharedObject.ts:510).
+        Default: subclasses override."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support stashed ops yet"
+        )
+
+    def signature(self) -> Any:
+        """Canonical user-visible content, for convergence checks.
+        Replica-local artifacts (tombstone granularity, intern order)
+        must not appear. Default: the summary blob."""
+        return self.summarize_core()
+
+
+class ChannelFactory(Protocol):
+    """IChannelFactory: how the runtime instantiates channel types."""
+
+    @property
+    def type_name(self) -> str: ...
+
+    def create(self, channel_id: str) -> SharedObject: ...
+
+    def load(self, channel_id: str, summary: dict) -> SharedObject: ...
+
+
+class ChannelRegistry:
+    """Maps channel type names to factories (ISharedObjectRegistry)."""
+
+    def __init__(self, factories: Optional[list[ChannelFactory]] = None):
+        self._factories: dict[str, ChannelFactory] = {}
+        for f in factories or []:
+            self.register(f)
+
+    def register(self, factory: ChannelFactory) -> None:
+        self._factories[factory.type_name] = factory
+
+    def get(self, type_name: str) -> ChannelFactory:
+        if type_name not in self._factories:
+            raise KeyError(f"unknown channel type {type_name!r}")
+        return self._factories[type_name]
+
+    @property
+    def types(self) -> tuple[str, ...]:
+        return tuple(self._factories)
+
+
+def simple_factory(cls) -> ChannelFactory:
+    """Factory for SharedObject subclasses with (channel_id) ctor and
+    load_core — the common case."""
+
+    class _Factory:
+        type_name = cls.type_name
+
+        def create(self, channel_id: str) -> SharedObject:
+            return cls(channel_id)
+
+        def load(self, channel_id: str, summary: dict) -> SharedObject:
+            obj = cls(channel_id)
+            obj.load_core(summary)
+            return obj
+
+    return _Factory()
