@@ -1,0 +1,18 @@
+"""Drivers: client <-> service adapters.
+
+Reference analogue: packages/drivers/*.
+"""
+from .definitions import DeltaStreamConnection, DocumentService
+from .file_driver import load_document, save_document
+from .local_driver import LocalDocumentService, LocalDocumentServiceFactory
+from .replay_driver import ReplayDocumentService
+
+__all__ = [
+    "DeltaStreamConnection",
+    "DocumentService",
+    "LocalDocumentService",
+    "LocalDocumentServiceFactory",
+    "ReplayDocumentService",
+    "load_document",
+    "save_document",
+]

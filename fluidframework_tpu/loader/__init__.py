@@ -1,0 +1,7 @@
+"""Loader layer: Container + delta management over drivers.
+
+Reference analogue: packages/loader/container-loader.
+"""
+from .container import Container
+
+__all__ = ["Container"]

@@ -1,0 +1,202 @@
+"""Container + delta management: the loader layer.
+
+Reference: packages/loader/container-loader/src — ``Container``
+(container.ts:270, load path, ``processRemoteMessage`` :1724),
+``DeltaManager`` (deltaManager.ts:96: inbound queue, gap detection +
+``fetchMissingDeltas`` :883, ``submit`` :213), ``ConnectionManager``
+(connectionManager.ts:152: reconnect), protocol handler + quorum
+wiring (src/protocol.ts).
+
+One Container = one client's live replica of one document: it loads
+from the latest service summary plus trailing ops, keeps a contiguous
+inbound stream (fetching gaps from delta storage), routes ops into its
+ContainerRuntime, and stamps outbound ops with csn/refSeq.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..drivers.definitions import DocumentService
+from ..models import default_registry
+from ..protocol.messages import (
+    ClientDetail,
+    DocumentMessage,
+    MessageType,
+    Nack,
+    SequencedMessage,
+)
+from ..protocol.quorum import ProtocolOpHandler
+from ..runtime import ChannelRegistry, ContainerRuntime
+from ..utils.events import EventEmitter
+
+
+class Container(EventEmitter):
+    def __init__(self, service: DocumentService,
+                 registry: Optional[ChannelRegistry] = None,
+                 client_id: str = ""):
+        super().__init__()
+        self.service = service
+        self.client_id = client_id
+        self.runtime = ContainerRuntime(registry or default_registry())
+        self.runtime.set_submit_fn(self._submit_runtime_op)
+        self.protocol = ProtocolOpHandler()
+        self.last_processed_seq = 0
+        self._connection = None
+        self._csn = 0
+        self.closed = False
+
+    # ------------------------------------------------------------------
+    # load (container.ts load path, §3.3)
+
+    @classmethod
+    def load(cls, service: DocumentService,
+             registry: Optional[ChannelRegistry] = None,
+             client_id: str = "", connect: bool = True) -> "Container":
+        container = cls(service, registry, client_id)
+        latest = service.get_latest_summary()
+        if latest is not None:
+            version_seq, summary = latest
+            container.runtime.load(summary.get("runtime", summary))
+            proto = summary.get("protocol")
+            if proto:
+                container.protocol = ProtocolOpHandler(
+                    minimum_sequence_number=proto["minimumSequenceNumber"],
+                    sequence_number=proto["sequenceNumber"],
+                    members={
+                        cid: ClientDetail(**detail)
+                        for cid, detail in proto["members"].items()
+                    },
+                    values=proto["values"],
+                )
+                # catch-up resumes at the snapshot's stream position,
+                # not the summary version's seq (the summarize op
+                # itself sequences after the snapshotted state)
+                base_seq = proto["sequenceNumber"]
+            else:
+                container.protocol = ProtocolOpHandler(
+                    minimum_sequence_number=version_seq,
+                    sequence_number=version_seq,
+                )
+                base_seq = version_seq
+            container.last_processed_seq = base_seq
+        # catch-up trailing ops from delta storage ("DocumentOpen",
+        # deltaManager.ts:451)
+        for msg in service.read_ops(container.last_processed_seq):
+            container._process(msg)
+        if connect:
+            container.connect()
+        return container
+
+    # ------------------------------------------------------------------
+    # connection lifecycle (connectionManager.ts:152)
+
+    @property
+    def connected(self) -> bool:
+        return self._connection is not None and self._connection.open
+
+    def connect(self) -> None:
+        assert not self.closed
+        if self.connected:
+            return
+        # catch up anything missed while disconnected, THEN attach the
+        # live stream (CatchingUp -> Connected, connectionStateHandler)
+        for msg in self.service.read_ops(self.last_processed_seq):
+            self._process(msg)
+        self._connection = self.service.connect_to_delta_stream(
+            self.client_id, self._on_message, self._on_nack
+        )
+        self._csn = 0
+        self.runtime.set_connection_state(True, self.client_id)
+        self.emit("connected")
+
+    def disconnect(self) -> None:
+        if self._connection is not None:
+            self._connection.disconnect()
+            self._connection = None
+        self.runtime.set_connection_state(False)
+        self.emit("disconnected")
+
+    def close(self) -> None:
+        self.disconnect()
+        self.closed = True
+
+    # ------------------------------------------------------------------
+    # inbound (DeltaManager inbound queue + gap refetch)
+
+    def _on_message(self, msg: SequencedMessage) -> None:
+        if msg.sequence_number <= self.last_processed_seq:
+            return  # duplicate delivery
+        if msg.sequence_number > self.last_processed_seq + 1:
+            # gap: fetch the missing range from delta storage
+            # (deltaManager.ts:883 fetchMissingDeltas)
+            for missing in self.service.read_ops(
+                self.last_processed_seq, msg.sequence_number - 1
+            ):
+                self._process(missing)
+        self._process(msg)
+
+    def _process(self, msg: SequencedMessage) -> None:
+        assert msg.sequence_number == self.last_processed_seq + 1, (
+            f"inbound stream broken: got {msg.sequence_number}, "
+            f"expected {self.last_processed_seq + 1}"
+        )
+        # Flush before the view advances: outbox ops must go out with
+        # the refSeq they were created against.
+        self.runtime.flush()
+        self.last_processed_seq = msg.sequence_number
+        self.protocol.process_message(msg)
+        if msg.type == MessageType.OPERATION:
+            self.runtime.process(msg)
+        elif msg.type == MessageType.SUMMARY_ACK:
+            self.emit("summaryAck", msg.contents)
+        elif msg.type == MessageType.SUMMARY_NACK:
+            self.emit("summaryNack", msg.contents)
+        self.emit("processed", msg)
+
+    def _on_nack(self, nack: Nack) -> None:
+        self.emit("nack", nack)
+
+    # ------------------------------------------------------------------
+    # outbound (DeltaManager.submit :213)
+
+    def _submit_runtime_op(self, contents: Any, metadata: Any) -> None:
+        if not self.connected:
+            return  # stays pending; replayed on reconnect
+        self._csn += 1
+        self._connection.submit(DocumentMessage(
+            client_sequence_number=self._csn,
+            reference_sequence_number=self.last_processed_seq,
+            type=MessageType.OPERATION,
+            contents=contents,
+        ))
+
+    def flush(self) -> None:
+        self.runtime.flush()
+
+    # ------------------------------------------------------------------
+    # summarization (client half of §3.4)
+
+    def summarize(self) -> dict:
+        """Produce and submit a summary; the service (scribe) acks it.
+        Requires a quiescent runtime (no pending local ops)."""
+        self.flush()
+        assert self.runtime.pending.count == 0, (
+            "summarize with in-flight local ops"
+        )
+        summary = {
+            "protocol": self.protocol.snapshot(),
+            "runtime": self.runtime.summarize(),
+        }
+        if self.connected:
+            self._csn += 1
+            self._connection.submit(DocumentMessage(
+                client_sequence_number=self._csn,
+                reference_sequence_number=self.last_processed_seq,
+                type=MessageType.SUMMARIZE,
+                contents={
+                    "summary": summary,
+                    "referenceSequenceNumber": self.last_processed_seq,
+                },
+            ))
+        return summary

@@ -1,0 +1,100 @@
+"""JSON serialization for sequenced streams (recorded corpora, file
+driver, wire format).
+
+Type-tagged encoding for op payloads: merge-tree ops are dataclasses,
+join payloads are ClientDetail, everything else is plain JSON.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+from ..models.mergetree.ops import (
+    AnnotateOp,
+    DeltaType,
+    GroupOp,
+    InsertOp,
+    RemoveOp,
+)
+from .messages import ClientDetail, MessageType, SequencedMessage
+
+_OP_CLASSES = {
+    DeltaType.INSERT: InsertOp,
+    DeltaType.REMOVE: RemoveOp,
+    DeltaType.ANNOTATE: AnnotateOp,
+    DeltaType.GROUP: GroupOp,
+}
+
+
+def encode_contents(value: Any) -> Any:
+    if isinstance(value, (InsertOp, RemoveOp, AnnotateOp)):
+        d = dataclasses.asdict(value)
+        d["type"] = int(value.type)
+        return {"__mergeop__": d}
+    if isinstance(value, GroupOp):
+        return {"__mergeop__": {
+            "type": int(DeltaType.GROUP),
+            "ops": [encode_contents(sub) for sub in value.ops],
+        }}
+    if isinstance(value, ClientDetail):
+        d = dataclasses.asdict(value)
+        d["scopes"] = list(d["scopes"])
+        return {"__clientdetail__": d}
+    if isinstance(value, dict):
+        return {k: encode_contents(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [encode_contents(v) for v in value]
+    return value
+
+
+def decode_contents(value: Any) -> Any:
+    if isinstance(value, dict):
+        if "__mergeop__" in value:
+            d = dict(value["__mergeop__"])
+            kind = DeltaType(d.pop("type"))
+            if kind == DeltaType.GROUP:
+                return GroupOp(ops=[decode_contents(o) for o in d["ops"]])
+            return _OP_CLASSES[kind](**d)
+        if "__clientdetail__" in value:
+            d = dict(value["__clientdetail__"])
+            d["scopes"] = tuple(d["scopes"])
+            return ClientDetail(**d)
+        return {k: decode_contents(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [decode_contents(v) for v in value]
+    return value
+
+
+def message_to_json(msg: SequencedMessage) -> dict:
+    return {
+        "clientId": msg.client_id,
+        "sequenceNumber": msg.sequence_number,
+        "minimumSequenceNumber": msg.minimum_sequence_number,
+        "clientSequenceNumber": msg.client_sequence_number,
+        "referenceSequenceNumber": msg.reference_sequence_number,
+        "type": int(msg.type),
+        "contents": encode_contents(msg.contents),
+        "timestamp": msg.timestamp,
+    }
+
+
+def message_from_json(data: dict) -> SequencedMessage:
+    return SequencedMessage(
+        client_id=data["clientId"],
+        sequence_number=data["sequenceNumber"],
+        minimum_sequence_number=data["minimumSequenceNumber"],
+        client_sequence_number=data["clientSequenceNumber"],
+        reference_sequence_number=data["referenceSequenceNumber"],
+        type=MessageType(data["type"]),
+        contents=decode_contents(data["contents"]),
+        timestamp=data.get("timestamp", 0.0),
+    )
+
+
+def dump_stream(messages: list[SequencedMessage]) -> str:
+    return json.dumps([message_to_json(m) for m in messages])
+
+
+def load_stream(text: str) -> list[SequencedMessage]:
+    return [message_from_json(d) for d in json.loads(text)]

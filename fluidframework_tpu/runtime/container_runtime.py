@@ -23,12 +23,15 @@ from .shared_object import ChannelRegistry
 @dataclass
 class PendingOp:
     """One locally-submitted op awaiting its ack
-    (pendingStateManager.ts pending message)."""
+    (pendingStateManager.ts pending message). ``kind`` is "op" for
+    channel ops, "attach" for channel-attach announcements
+    (ContainerMessageType.Attach, containerRuntime.ts:1701 switch)."""
 
     datastore_id: str
     channel_id: str
     contents: Any
     metadata: Any
+    kind: str = "op"
 
 
 class PendingStateManager:
@@ -122,14 +125,31 @@ class ContainerRuntime(EventEmitter):
         op = PendingOp(datastore_id, channel_id, contents, metadata)
         self._outbox.append(op)
 
+    def submit_attach(self, datastore_id: str, channel_id: str,
+                      channel_type: str, summary: dict) -> None:
+        """Announce a locally-created channel so remote containers can
+        materialize it (the Attach op: a new channel's type + initial
+        snapshot travel in the op stream)."""
+        self._outbox.append(PendingOp(
+            datastore_id, channel_id,
+            {"channelType": channel_type, "summary": summary},
+            None, kind="attach",
+        ))
+
     def flush(self) -> int:
-        """Send every batched op (outbox.ts:102). Returns count sent."""
+        """Send every batched op (outbox.ts:102). Returns count sent.
+
+        Drains atomically up front: with an in-proc synchronous service
+        a submit can deliver (and re-enter flush) before this call
+        returns, and the op must not be sent twice."""
+        ops, self._outbox = self._outbox, []
         sent = 0
-        for op in self._outbox:
+        for op in ops:
             self.pending.on_submit(op)
             if self._submit_fn is not None:
                 self._submit_fn(
                     {
+                        "kind": op.kind,
                         "address": op.datastore_id,
                         "channel": op.channel_id,
                         "contents": op.contents,
@@ -137,7 +157,6 @@ class ContainerRuntime(EventEmitter):
                     op.metadata,
                 )
             sent += 1
-        self._outbox.clear()
         return sent
 
     def order_sequentially(self, callback: Callable[[], None]) -> None:
@@ -158,6 +177,10 @@ class ContainerRuntime(EventEmitter):
         if local:
             pending_op = self.pending.on_local_ack(msg)
             local_metadata = pending_op.metadata
+        if envelope.get("kind") == "attach":
+            if not local:
+                self._process_attach(envelope)
+            return
         ds = self.datastores[envelope["address"]]
         ds.process(
             msg, envelope["channel"], envelope["contents"], local,
@@ -165,12 +188,30 @@ class ContainerRuntime(EventEmitter):
         )
         self.emit("op", msg, local)
 
+    def _process_attach(self, envelope: dict) -> None:
+        """Materialize a remotely-created channel (lazy realization —
+        RemoteChannelContext). A same-id channel both sides created is
+        deduplicated: first attach wins, later ones no-op."""
+        ds_id, ch_id = envelope["address"], envelope["channel"]
+        if ds_id not in self.datastores:
+            self.create_datastore(ds_id)
+        ds = self.datastores[ds_id]
+        if ch_id in ds.channels:
+            return
+        contents = envelope["contents"]
+        ds.load_channel(
+            contents["channelType"], ch_id, contents["summary"]
+        )
+
     # ------------------------------------------------------------------
     # reconnect (replayPendingStates :1573)
 
     def _replay_pending(self) -> None:
         self.reconnect_epoch += 1
         for op in self.pending.drain():
+            if op.kind == "attach":
+                self._outbox.append(op)  # attach replays verbatim
+                continue
             channel = self.datastores[op.datastore_id].channels[
                 op.channel_id
             ]

@@ -58,6 +58,10 @@ class DataStoreRuntime:
         channel = self.registry.get(type_name).create(channel_id)
         self.channels[channel_id] = channel
         channel.connect(_ChannelServices(self, channel_id))
+        # announce to remote containers (Attach op)
+        self.container.submit_attach(
+            self.id, channel_id, type_name, channel.summarize_core()
+        )
         return channel
 
     def load_channel(self, type_name: str, channel_id: str,

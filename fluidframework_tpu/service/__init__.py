@@ -1,7 +1,30 @@
-"""Service plane: sequencer (deli), orderer pipeline, ingress.
+"""Service plane: sequencer (deli), lambda pipeline, in-proc orderer,
+local server, TPU merge sidecar.
 
 Reference analogue: server/routerlicious/packages/*.
 """
+from .lambdas import (
+    BroadcasterLambda,
+    OpLog,
+    ScribeLambda,
+    ScriptoriumLambda,
+    SummaryStore,
+)
+from .local_orderer import LocalOrderer
+from .local_server import DeltaConnection, LocalServer
 from .sequencer import DocumentSequencer, TicketResult
+from .tpu_sidecar import TpuMergeSidecar
 
-__all__ = ["DocumentSequencer", "TicketResult"]
+__all__ = [
+    "BroadcasterLambda",
+    "DeltaConnection",
+    "DocumentSequencer",
+    "LocalOrderer",
+    "LocalServer",
+    "OpLog",
+    "ScribeLambda",
+    "ScriptoriumLambda",
+    "SummaryStore",
+    "TicketResult",
+    "TpuMergeSidecar",
+]

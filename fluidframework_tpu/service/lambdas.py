@@ -1,0 +1,157 @@
+"""Service pipeline stages — the routerlicious lambda equivalents.
+
+Reference: server/routerlicious/packages/lambdas/src:
+- deli (lambda.ts:192): sequencing — our ``DocumentSequencer`` wrapped
+  by the orderer,
+- scriptorium (scriptorium/lambda.ts:20): durable op log writes,
+- broadcaster (broadcaster/lambda.ts:49): fan-out to connections,
+- scribe (scribe/lambda.ts:46): server-side protocol replica that
+  validates summaries and emits summaryAck/Nack.
+
+Stages are synchronous callables over sequenced messages; the orderer
+pipes deli's output through them in order (the reference's Kafka topics
+collapse to direct calls in-proc, exactly like memory-orderer's
+LocalKafka).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..protocol.messages import MessageType, SequencedMessage, Trace
+from ..protocol.quorum import ProtocolOpHandler
+
+
+class OpLog:
+    """Scriptorium's Mongo op collection, in memory: the durable
+    sequenced-op store backing delta storage reads
+    (scriptorium/lambda.ts:20)."""
+
+    def __init__(self) -> None:
+        self._ops: list[SequencedMessage] = []
+
+    def append(self, msg: SequencedMessage) -> None:
+        if self._ops:
+            assert msg.sequence_number == self._ops[-1].sequence_number + 1, (
+                "op log must stay contiguous"
+            )
+        self._ops.append(msg)
+
+    def read(self, from_seq: int, to_seq: Optional[int] = None
+             ) -> list[SequencedMessage]:
+        """Ops with from_seq < seq <= to_seq (delta-storage range
+        semantics)."""
+        out = []
+        for msg in self._ops:
+            if msg.sequence_number <= from_seq:
+                continue
+            if to_seq is not None and msg.sequence_number > to_seq:
+                break
+            out.append(msg)
+        return out
+
+    def truncate_below(self, seq: int) -> int:
+        """Drop ops at/below ``seq`` (durableSequenceNumber advance —
+        deli/lambda.ts:342 area). Returns dropped count."""
+        before = len(self._ops)
+        self._ops = [m for m in self._ops if m.sequence_number > seq]
+        return before - len(self._ops)
+
+    @property
+    def last_seq(self) -> int:
+        return self._ops[-1].sequence_number if self._ops else 0
+
+    def __len__(self) -> int:
+        return len(self._ops)
+
+
+class ScriptoriumLambda:
+    def __init__(self, op_log: OpLog):
+        self.op_log = op_log
+
+    def handler(self, msg: SequencedMessage) -> None:
+        msg.traces.append(Trace("scriptorium", "write"))
+        self.op_log.append(msg)
+
+
+class BroadcasterLambda:
+    """broadcaster/lambda.ts:49 — per-document fan-out."""
+
+    def __init__(self) -> None:
+        self._subscribers: dict[str, Callable[[SequencedMessage], None]] = {}
+
+    def subscribe(self, subscriber_id: str,
+                  handler: Callable[[SequencedMessage], None]) -> None:
+        self._subscribers[subscriber_id] = handler
+
+    def unsubscribe(self, subscriber_id: str) -> None:
+        self._subscribers.pop(subscriber_id, None)
+
+    def handler(self, msg: SequencedMessage) -> None:
+        msg.traces.append(Trace("broadcaster", "fanout"))
+        for handler in list(self._subscribers.values()):
+            handler(msg)
+
+
+@dataclass
+class ServiceSummary:
+    sequence_number: int
+    summary: dict
+    timestamp: float = field(default_factory=time.time)
+
+
+class SummaryStore:
+    """The git-storage stand-in (historian/gitrest): versioned summary
+    blobs per document."""
+
+    def __init__(self) -> None:
+        self.versions: list[ServiceSummary] = []
+
+    def write(self, sequence_number: int, summary: dict) -> int:
+        self.versions.append(ServiceSummary(sequence_number, summary))
+        return len(self.versions) - 1
+
+    def latest(self) -> Optional[ServiceSummary]:
+        return self.versions[-1] if self.versions else None
+
+
+class ScribeLambda:
+    """scribe/lambda.ts:46 — holds a server-side ProtocolOpHandler,
+    validates client summaries, writes service summaries, and emits
+    summaryAck ops back through the sequencer."""
+
+    def __init__(self, summary_store: SummaryStore,
+                 submit_system_op: Callable[[MessageType, Any], None],
+                 op_log: Optional[OpLog] = None):
+        self.protocol = ProtocolOpHandler()
+        self.summary_store = summary_store
+        self._submit_system_op = submit_system_op
+        self._op_log = op_log
+
+    def handler(self, msg: SequencedMessage) -> None:
+        msg.traces.append(Trace("scribe", "process"))
+        self.protocol.process_message(msg)
+        if msg.type == MessageType.SUMMARIZE:
+            self._handle_summarize(msg)
+
+    def _handle_summarize(self, msg: SequencedMessage) -> None:
+        contents = msg.contents or {}
+        summary = contents.get("summary")
+        if not isinstance(summary, dict):
+            self._submit_system_op(MessageType.SUMMARY_NACK, {
+                "summaryProposal": msg.sequence_number,
+                "message": "malformed summary payload",
+            })
+            return
+        handle = self.summary_store.write(msg.sequence_number, summary)
+        # Ack advances the durable sequence number: ops at/below the
+        # summarized seq can be truncated from the log (§3.4).
+        if self._op_log is not None:
+            self._op_log.truncate_below(
+                contents.get("referenceSequenceNumber", 0)
+            )
+        self._submit_system_op(MessageType.SUMMARY_ACK, {
+            "summaryProposal": msg.sequence_number,
+            "handle": handle,
+        })

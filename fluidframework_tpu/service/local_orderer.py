@@ -1,0 +1,124 @@
+"""In-process orderer: the full deli -> {scriptorium, scribe,
+broadcaster} pipeline for one document.
+
+Reference: server/routerlicious/packages/memory-orderer/src/
+localOrderer.ts (``setupLambdas`` :237) — the whole service in-proc
+over an in-memory Kafka; used by tinylicious/local-server and every
+integration test (SURVEY §4 pillar (c)).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Optional
+
+from ..protocol.messages import (
+    ClientDetail,
+    DocumentMessage,
+    MessageType,
+    Nack,
+    SequencedMessage,
+)
+from .lambdas import (
+    BroadcasterLambda,
+    OpLog,
+    ScribeLambda,
+    ScriptoriumLambda,
+    SummaryStore,
+)
+from .sequencer import DocumentSequencer
+
+
+class LocalOrderer:
+    """One document's ordering service instance."""
+
+    def __init__(self, document_id: str):
+        self.document_id = document_id
+        self.op_log = OpLog()
+        self.summary_store = SummaryStore()
+        self.sequencer = DocumentSequencer(document_id)
+        self.scriptorium = ScriptoriumLambda(self.op_log)
+        self.broadcaster = BroadcasterLambda()
+        self.scribe = ScribeLambda(
+            self.summary_store, self._submit_system_op, self.op_log
+        )
+        # deli out-topic consumers, in order (localOrderer.ts:237)
+        self._pipeline: list[Callable[[SequencedMessage], None]] = [
+            self.scriptorium.handler,
+            self.scribe.handler,
+            self.broadcaster.handler,
+        ]
+        # The reference decouples stages with Kafka topics; in-proc we
+        # flatten re-entrancy with a pump: a submit made from inside a
+        # delivery enqueues and is dispatched after the current message
+        # finishes (LocalKafka's async delivery, memory-orderer).
+        self._dispatch_queue: deque[SequencedMessage] = deque()
+        self._dispatching = False
+
+    # ------------------------------------------------------------------
+    # ingress (alfred submitOp path)
+
+    def connect(self, detail: ClientDetail) -> SequencedMessage:
+        join = self.sequencer.client_join(detail)
+        self._dispatch(join)
+        return join
+
+    def disconnect(self, client_id: str) -> Optional[SequencedMessage]:
+        leave = self.sequencer.client_leave(client_id)
+        if leave is not None:
+            self._dispatch(leave)
+        return leave
+
+    def submit(self, client_id: str,
+               op: DocumentMessage) -> Optional[Nack]:
+        result = self.sequencer.ticket(client_id, op)
+        if result.nack is not None:
+            return result.nack
+        if result.message is not None:
+            self._dispatch(result.message)
+        return None
+
+    # ------------------------------------------------------------------
+
+    def _submit_system_op(self, msg_type: MessageType,
+                          contents: Any) -> None:
+        """Scribe emits summaryAck/Nack as service-generated sequenced
+        ops (scribe -> deli loopback)."""
+        seq = self.sequencer.sequence_number + 1
+        self.sequencer.sequence_number = seq
+        self._dispatch(SequencedMessage(
+            client_id=None,
+            sequence_number=seq,
+            minimum_sequence_number=self.sequencer.minimum_sequence_number,
+            client_sequence_number=-1,
+            reference_sequence_number=-1,
+            type=msg_type,
+            contents=contents,
+        ))
+
+    def _dispatch(self, msg: SequencedMessage) -> None:
+        self._dispatch_queue.append(msg)
+        if self._dispatching:
+            return
+        self._dispatching = True
+        try:
+            while self._dispatch_queue:
+                current = self._dispatch_queue.popleft()
+                for stage in self._pipeline:
+                    stage(current)
+        finally:
+            self._dispatching = False
+
+    # ------------------------------------------------------------------
+    # checkpoint/resume (deli/checkpointContext.ts + scribe state)
+
+    def checkpoint(self) -> dict:
+        return {"sequencer": self.sequencer.checkpoint()}
+
+    def restore(self, state: dict) -> None:
+        self.sequencer = DocumentSequencer.restore(state["sequencer"])
+        # scribe's replica resumes at the checkpointed stream position
+        # (scribe/lambda.ts:108 skips replayed messages below it)
+        self.scribe.protocol.sequence_number = self.sequencer.sequence_number
+        self.scribe.protocol.minimum_sequence_number = (
+            self.sequencer.minimum_sequence_number
+        )

@@ -1,0 +1,94 @@
+"""Local delta-connection server: the whole multi-document service
+in-proc.
+
+Reference: server/routerlicious/packages/local-server/src/
+localDeltaConnectionServer.ts (:61) + localWebSocketServer.ts (:77) —
+the integration-test backbone (SURVEY §4 pillar (c)): real sequencing,
+msn, nacks and summaries with zero deployment. Our connection objects
+stand in for sockets.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Optional
+
+from ..protocol.messages import (
+    ClientDetail,
+    DocumentMessage,
+    Nack,
+    SequencedMessage,
+)
+from .local_orderer import LocalOrderer
+
+
+class DeltaConnection:
+    """One client's live connection to a document (the socket
+    analogue: driver-base/src/documentDeltaConnection.ts:41)."""
+
+    def __init__(self, server: "LocalServer", orderer: LocalOrderer,
+                 client_id: str, connection_id: str):
+        self._server = server
+        self._orderer = orderer
+        self.client_id = client_id
+        self.connection_id = connection_id
+        self.open = True
+        self.on_message: Optional[Callable[[SequencedMessage], None]] = None
+        self.on_nack: Optional[Callable[[Nack], None]] = None
+
+    def submit(self, op: DocumentMessage) -> None:
+        assert self.open, "submit on closed connection"
+        nack = self._orderer.submit(self.client_id, op)
+        if nack is not None and self.on_nack is not None:
+            self.on_nack(nack)
+
+    def disconnect(self) -> None:
+        if not self.open:
+            return
+        self.open = False
+        self._orderer.broadcaster.unsubscribe(self.connection_id)
+        self._orderer.disconnect(self.client_id)
+
+
+class LocalServer:
+    """Multi-document service: one LocalOrderer per document
+    (document-parallelism — SURVEY §2.9 axis 1)."""
+
+    def __init__(self) -> None:
+        self.documents: dict[str, LocalOrderer] = {}
+        self._conn_counter = itertools.count()
+
+    def get_orderer(self, document_id: str) -> LocalOrderer:
+        if document_id not in self.documents:
+            self.documents[document_id] = LocalOrderer(document_id)
+        return self.documents[document_id]
+
+    # ------------------------------------------------------------------
+    # connection lifecycle (connect_document handshake,
+    # lambdas/src/alfred/index.ts:465)
+
+    def connect(self, document_id: str, client_id: str,
+                on_message: Callable[[SequencedMessage], None],
+                on_nack: Optional[Callable[[Nack], None]] = None,
+                ) -> DeltaConnection:
+        orderer = self.get_orderer(document_id)
+        connection_id = f"conn-{next(self._conn_counter)}"
+        conn = DeltaConnection(self, orderer, client_id, connection_id)
+        conn.on_message = on_message
+        conn.on_nack = on_nack
+        # subscribe BEFORE the join op so the client sees its own join
+        orderer.broadcaster.subscribe(
+            connection_id, lambda msg: conn.on_message and
+            conn.on_message(msg)
+        )
+        orderer.connect(ClientDetail(client_id))
+        return conn
+
+    # ------------------------------------------------------------------
+    # storage plane (delta storage + summaries)
+
+    def read_ops(self, document_id: str, from_seq: int,
+                 to_seq: Optional[int] = None) -> list[SequencedMessage]:
+        return self.get_orderer(document_id).op_log.read(from_seq, to_seq)
+
+    def latest_summary(self, document_id: str):
+        return self.get_orderer(document_id).summary_store.latest()

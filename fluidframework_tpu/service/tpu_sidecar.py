@@ -1,0 +1,165 @@
+"""TPU merge sidecar: device-resident merge state for the service
+plane.
+
+The north star (BASELINE.json): the ordering service's op stream is
+batched into padded tensors and merge resolution runs on-device across
+thousands of documents per dispatch, while the per-client host path
+stays untouched. The sidecar subscribes to sequenced channel streams
+(deli out-topic / broadcaster fan-out), accumulates per-document
+windows, applies them with ``ops.apply_window``, and serves
+text/summary state — powering service-side summarization, replay
+validation, and the batched benchmarks.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..ops import (
+    DocStream,
+    OpBatch,
+    apply_window,
+    compact,
+    extract_signature,
+    extract_text,
+    fetch,
+    make_table,
+)
+from ..ops.host_bridge import OP_FIELDS
+from ..ops.segment_table import KIND_NOOP
+from ..protocol.messages import MessageType, SequencedMessage
+
+
+class TpuMergeSidecar:
+    """Batched merge state for up to ``max_docs`` sequence channels.
+
+    One tracked channel (doc slot) = one (document, datastore, channel)
+    sequence stream. ``ingest`` consumes the document's sequenced
+    envelope stream; ``apply`` flushes accumulated windows to the
+    device in a single dispatch.
+    """
+
+    def __init__(self, max_docs: int = 1024, capacity: int = 1024,
+                 compact_every: int = 8):
+        self.max_docs = max_docs
+        self.capacity = capacity
+        self._table = make_table(max_docs, capacity)
+        self._slots: dict[tuple[str, str, str], int] = {}
+        self._streams: list[DocStream] = []
+        self._queued: list[list[dict]] = []
+        self._applies = 0
+        self._compact_every = compact_every
+
+    # ------------------------------------------------------------------
+    # registration + ingest
+
+    def track(self, document_id: str, datastore_id: str,
+              channel_id: str) -> int:
+        key = (document_id, datastore_id, channel_id)
+        if key in self._slots:
+            return self._slots[key]
+        if len(self._streams) >= self.max_docs:
+            raise RuntimeError("sidecar document capacity exhausted")
+        slot = len(self._streams)
+        self._slots[key] = slot
+        self._streams.append(DocStream())
+        self._queued.append([])
+        return slot
+
+    def subscribe(self, server, document_id: str, datastore_id: str,
+                  channel_id: str) -> None:
+        """Attach to a LocalServer document's broadcaster (the
+        sidecar's place in the pipeline: after deli, beside
+        scriptorium)."""
+        self.track(document_id, datastore_id, channel_id)
+        orderer = server.get_orderer(document_id)
+        orderer.broadcaster.subscribe(
+            f"tpu-sidecar/{document_id}/{datastore_id}/{channel_id}",
+            lambda msg: self.ingest(document_id, msg),
+        )
+
+    def ingest(self, document_id: str, msg: SequencedMessage) -> None:
+        """Consume one sequenced message of a document: channel ops for
+        tracked channels encode as kernel ops; everything else becomes
+        a NOOP that still advances the collab window."""
+        for (doc, ds_id, ch_id), slot in self._slots.items():
+            if doc != document_id:
+                continue
+            stream = self._streams[slot]
+            before = len(stream.ops)
+            envelope = msg.contents if isinstance(msg.contents, dict) else {}
+            if (
+                msg.type == MessageType.OPERATION
+                and envelope.get("kind", "op") == "op"
+                and envelope.get("address") == ds_id
+                and envelope.get("channel") == ch_id
+            ):
+                inner = SequencedMessage(
+                    client_id=msg.client_id,
+                    sequence_number=msg.sequence_number,
+                    minimum_sequence_number=msg.minimum_sequence_number,
+                    client_sequence_number=msg.client_sequence_number,
+                    reference_sequence_number=(
+                        msg.reference_sequence_number
+                    ),
+                    type=msg.type,
+                    contents=envelope["contents"],
+                )
+                stream.add_message(inner)
+            else:
+                stream.add_noop(msg.minimum_sequence_number)
+            self._queued[slot].extend(stream.ops[before:])
+
+    # ------------------------------------------------------------------
+    # device application
+
+    @property
+    def queued_ops(self) -> int:
+        return sum(len(q) for q in self._queued)
+
+    def apply(self) -> int:
+        """Flush all queued windows in one batched dispatch. Returns
+        the number of real (non-noop) ops applied."""
+        if not self._queued or self.queued_ops == 0:
+            return 0
+        docs = self.max_docs
+        window = max(len(q) for q in self._queued)
+        arrays = {f: np.zeros((docs, window), np.int32)
+                  for f in OP_FIELDS}
+        arrays["kind"][:] = KIND_NOOP
+        real = 0
+        for slot, queue in enumerate(self._queued):
+            for w, op in enumerate(queue):
+                for f in OP_FIELDS:
+                    arrays[f][slot, w] = op[f]
+                if op["kind"] != KIND_NOOP:
+                    real += 1
+            queue.clear()
+        self._table = apply_window(self._table, OpBatch(**arrays))
+        self._applies += 1
+        if self._applies % self._compact_every == 0:
+            self._table = compact(self._table)
+        return real
+
+    # ------------------------------------------------------------------
+    # reads (service-side summarization / validation)
+
+    def _slot(self, document_id: str, datastore_id: str,
+              channel_id: str) -> int:
+        return self._slots[(document_id, datastore_id, channel_id)]
+
+    def text(self, document_id: str, datastore_id: str,
+             channel_id: str) -> str:
+        slot = self._slot(document_id, datastore_id, channel_id)
+        return extract_text(fetch(self._table), self._streams[slot], slot)
+
+    def signature(self, document_id: str, datastore_id: str,
+                  channel_id: str) -> tuple:
+        slot = self._slot(document_id, datastore_id, channel_id)
+        return extract_signature(
+            fetch(self._table), self._streams[slot], slot
+        )
+
+    def overflowed(self) -> bool:
+        return bool(np.asarray(self._table.overflow).any())
