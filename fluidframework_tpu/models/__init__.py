@@ -6,6 +6,7 @@ from ..runtime.shared_object import ChannelRegistry, simple_factory
 from .cell import SharedCell
 from .counter import SharedCounter
 from .map import MapKernel, SharedDirectory, SharedMap
+from .matrix import SharedMatrix
 from .sharedstring import SharedString
 
 
@@ -14,6 +15,7 @@ def default_registry() -> ChannelRegistry:
     catalogue)."""
     return ChannelRegistry([
         simple_factory(SharedString),
+        simple_factory(SharedMatrix),
         simple_factory(SharedMap),
         simple_factory(SharedDirectory),
         simple_factory(SharedCell),
@@ -27,6 +29,7 @@ __all__ = [
     "SharedCounter",
     "SharedDirectory",
     "SharedMap",
+    "SharedMatrix",
     "SharedString",
     "default_registry",
 ]

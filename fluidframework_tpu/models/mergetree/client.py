@@ -86,6 +86,55 @@ class MergeTreeClient:
         self._apply_local(op)
         return op
 
+    def insert_run_local(self, pos: int, count: int,
+                         alloc_id: str) -> InsertOp:
+        """Insert a run of ``count`` positions with stable handles
+        (alloc_id, 0..count-1) — the PermutationVector primitive
+        (matrix/src/permutationvector.ts:137)."""
+        op = InsertOp(pos1=pos, text="\x00" * count,
+                      handle=[alloc_id, 0])
+        self._apply_local(op)
+        return op
+
+    def handle_at(self, pos: int) -> Optional[str]:
+        """Stable handle of the row/col currently at ``pos`` in the
+        local view."""
+        tree = self.mergetree
+        remaining = pos
+        for seg in tree.segments:
+            length = tree._length_at(
+                seg, tree.collab.current_seq, self._local_id
+            )
+            if not length:
+                continue
+            if remaining < length:
+                if seg.handle_base is None:
+                    return None
+                alloc, off = seg.handle_base
+                return f"{alloc}:{off + remaining}"
+            remaining -= length
+        return None
+
+    def position_of_handle(self, handle: str) -> Optional[int]:
+        """Current position of a stable handle, or None if the
+        row/col is gone from the local view."""
+        alloc, _, off_s = handle.rpartition(":")
+        off = int(off_s)
+        tree = self.mergetree
+        acc = 0
+        for seg in tree.segments:
+            length = tree._length_at(
+                seg, tree.collab.current_seq, self._local_id
+            )
+            if seg.handle_base is not None:
+                salloc, soff = seg.handle_base
+                if salloc == alloc and soff <= off < soff + seg.length:
+                    if not length:
+                        return None  # removed in local view
+                    return acc + (off - soff)
+            acc += length or 0
+        return None
+
     def remove_range_local(self, start: int, end: int) -> RemoveOp:
         op = RemoveOp(pos1=start, pos2=end)
         self._apply_local(op)
@@ -147,6 +196,9 @@ class MergeTreeClient:
                 op.pos1, refseq, client_id, seq,
                 text=op.text, marker=op.marker, props=op.props,
                 local_seq=local_seq,
+                handle_base=(
+                    tuple(op.handle) if op.handle is not None else None
+                ),
             )
             return [seg]
         if op.type == DeltaType.REMOVE:
@@ -242,6 +294,10 @@ class MergeTreeClient:
                     sub_ops.append(InsertOp(
                         pos1=pos, text=seg.text,
                         marker=seg.marker, props=group.props,
+                        handle=(
+                            list(seg.handle_base)
+                            if seg.handle_base is not None else None
+                        ),
                     ))
                 elif group.kind == DeltaType.REMOVE:
                     if seg.removal_acked:

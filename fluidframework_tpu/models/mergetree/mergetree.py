@@ -191,6 +191,7 @@ class MergeTree:
         marker: Optional[dict] = None,
         props: Optional[dict] = None,
         local_seq: Optional[int] = None,
+        handle_base: Optional[tuple] = None,
     ) -> Segment:
         index, offset = self._find_insert_index(
             pos, refseq, client_id, seq, local_seq
@@ -205,6 +206,7 @@ class MergeTree:
             client_id=client_id,
             local_seq=local_seq,
             props=dict(props) if props else None,
+            handle_base=handle_base,
         )
         self.segments.insert(index, seg)
         self._advance(seq)

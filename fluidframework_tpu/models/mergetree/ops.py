@@ -37,6 +37,8 @@ class InsertOp:
     text: Optional[str] = None           # text segment payload
     marker: Optional[dict] = None        # {"refType": int} marker payload
     props: Optional[dict] = None
+    # permutation-vector runs: stable handle allocation [alloc_id, off]
+    handle: Optional[list] = None
 
 
 @dataclass

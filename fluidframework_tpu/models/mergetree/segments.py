@@ -37,6 +37,11 @@ class Segment:
 
     # annotate state
     props: Optional[dict] = None
+
+    # permutation-vector provenance (SharedMatrix axes): stable handle
+    # allocation (alloc_id, offset) — position i in this segment has
+    # handle (alloc_id, offset + i); follows splits
+    handle_base: Optional[tuple] = None
     # per-key count of local annotates awaiting ack (pending wins)
     pending_props: Optional[dict] = None
 
@@ -78,6 +83,10 @@ class Segment:
             removed_client_ids=list(self.removed_client_ids),
             local_removed_seq=self.local_removed_seq,
             props=dict(self.props) if self.props is not None else None,
+            handle_base=(
+                (self.handle_base[0], self.handle_base[1] + offset)
+                if self.handle_base is not None else None
+            ),
             pending_props=(
                 dict(self.pending_props)
                 if self.pending_props is not None else None
@@ -92,11 +101,22 @@ class Segment:
     def can_append(self, other: "Segment") -> bool:
         """Zamboni merge eligibility (both below the collab window is
         checked by the caller)."""
+        handles_contiguous = (
+            (self.handle_base is None and other.handle_base is None)
+            or (
+                self.handle_base is not None
+                and other.handle_base is not None
+                and self.handle_base[0] == other.handle_base[0]
+                and self.handle_base[1] + len(self.text or "")
+                == other.handle_base[1]
+            )
+        )
         return (
             self.text is not None
             and other.text is not None
             and self.removed is other.removed
             and self.props == other.props
+            and handles_contiguous
         )
 
 

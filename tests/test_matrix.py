@@ -1,0 +1,139 @@
+"""SharedMatrix tests: concurrent permutations + cell LWW.
+
+Mirrors packages/dds/matrix/src/test patterns over the container
+session."""
+import random
+
+import pytest
+
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+
+def make(n=2):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    s = ContainerSession(ids)
+    for cid in ids:
+        s.runtime(cid).create_datastore("d").create_channel(
+            "sharedmatrix", "m"
+        )
+    return s, ids
+
+
+def mat(s, cid):
+    return s.runtime(cid).get_datastore("d").get_channel("m")
+
+
+def test_basic_grid():
+    s, _ = make()
+    a = mat(s, "A")
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 3)
+    a.set_cell(0, 0, "x")
+    a.set_cell(1, 2, 42)
+    s.process_all()
+    s.assert_converged()
+    b = mat(s, "B")
+    assert b.row_count == 2 and b.col_count == 3
+    assert b.get_cell(0, 0) == "x"
+    assert b.get_cell(1, 2) == 42
+
+
+def test_cell_survives_concurrent_row_insert():
+    """setCell targets handles, so concurrent permutations cannot
+    displace it."""
+    s, _ = make()
+    a, b = mat(s, "A"), mat(s, "B")
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 2)
+    s.process_all()
+    a.insert_rows(0, 1)        # shifts row indices (sequenced first)
+    b.set_cell(1, 1, "keep")   # concurrent: targets old row 1
+    s.process_all()
+    s.assert_converged()
+    # the cell followed its row (now at index 2)
+    assert a.get_cell(2, 1) == "keep"
+    assert b.get_cell(2, 1) == "keep"
+
+
+def test_concurrent_cell_set_lww():
+    s, _ = make()
+    a, b = mat(s, "A"), mat(s, "B")
+    a.insert_rows(0, 1)
+    a.insert_cols(0, 1)
+    s.process_all()
+    b.set_cell(0, 0, "first")
+    s.flush("B")                # sequenced first
+    a.set_cell(0, 0, "second")
+    s.flush("A")                # sequenced second -> wins
+    s.process_all()
+    s.assert_converged()
+    assert a.get_cell(0, 0) == "second"
+    assert b.get_cell(0, 0) == "second"
+
+
+def test_remove_rows_hides_cells():
+    s, _ = make()
+    a = mat(s, "A")
+    a.insert_rows(0, 3)
+    a.insert_cols(0, 1)
+    a.set_cell(1, 0, "doomed")
+    a.set_cell(2, 0, "stays")
+    s.process_all()
+    a.remove_rows(1, 1)
+    s.process_all()
+    s.assert_converged()
+    b = mat(s, "B")
+    assert b.row_count == 2
+    assert b.get_cell(1, 0) == "stays"
+
+
+def test_matrix_summary_roundtrip():
+    s, _ = make()
+    a = mat(s, "A")
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 2)
+    a.set_cell(0, 1, "v")
+    s.process_all()
+    s.assert_converged()
+    import json
+    summary = a.summarize_core()
+    json.dumps(summary)
+    from fluidframework_tpu.models import SharedMatrix
+    loaded = SharedMatrix("m2")
+    loaded.load_core(summary)
+    assert loaded.row_count == 2 and loaded.col_count == 2
+    assert loaded.get_cell(0, 1) == "v"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_matrix_fuzz(seed):
+    rng = random.Random(seed + 31)
+    s, ids = make(3)
+    for cid in ids:
+        pass
+    # seed a base grid
+    mat(s, "A").insert_rows(0, 2)
+    mat(s, "A").insert_cols(0, 2)
+    s.process_all()
+    for _ in range(120):
+        cid = rng.choice(ids)
+        m = mat(s, cid)
+        r = rng.random()
+        if r < 0.25 and s.pending_count:
+            s.process_some(rng.randint(1, s.pending_count))
+        elif r < 0.4:
+            m.insert_rows(rng.randint(0, m.row_count), rng.randint(1, 2))
+        elif r < 0.5:
+            m.insert_cols(rng.randint(0, m.col_count), rng.randint(1, 2))
+        elif r < 0.6 and m.row_count > 1:
+            pos = rng.randint(0, m.row_count - 1)
+            m.remove_rows(pos, 1)
+        elif r < 0.65 and m.col_count > 1:
+            pos = rng.randint(0, m.col_count - 1)
+            m.remove_cols(pos, 1)
+        elif m.row_count and m.col_count:
+            m.set_cell(rng.randint(0, m.row_count - 1),
+                       rng.randint(0, m.col_count - 1),
+                       rng.randint(0, 99))
+    s.process_all()
+    s.assert_converged()
