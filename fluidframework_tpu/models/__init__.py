@@ -8,6 +8,7 @@ from .counter import SharedCounter
 from .map import MapKernel, SharedDirectory, SharedMap
 from .matrix import SharedMatrix
 from .sharedstring import SharedString
+from .tree import SharedTree
 
 
 def default_registry() -> ChannelRegistry:
@@ -20,6 +21,7 @@ def default_registry() -> ChannelRegistry:
         simple_factory(SharedDirectory),
         simple_factory(SharedCell),
         simple_factory(SharedCounter),
+        simple_factory(SharedTree),
     ])
 
 
@@ -31,5 +33,6 @@ __all__ = [
     "SharedMap",
     "SharedMatrix",
     "SharedString",
+    "SharedTree",
     "default_registry",
 ]

@@ -1,0 +1,11 @@
+"""SharedTree: op-based tree CRDT with rebasing (packages/dds/tree)."""
+from . import changeset
+from .changeset import compose, invert, rebase
+from .editmanager import Commit, EditManager
+from .forest import Forest, node
+from .sharedtree import SharedTree, wrap_path
+
+__all__ = [
+    "changeset", "compose", "invert", "rebase",
+    "Commit", "EditManager", "Forest", "node", "SharedTree", "wrap_path",
+]

@@ -1,0 +1,175 @@
+"""SharedTree: the op-based tree DDS with rebasing merge semantics.
+
+Reference: packages/dds/tree/src/shared-tree-core/sharedTreeCore.ts:73
+(SharedObject glue: ``processCore`` -> ``editManager.addSequencedChange``
+:209,:234; summaries from pluggable indexes — here a forest index and an
+edit-manager index, mirroring feature-libraries/editManagerIndex.ts) and
+shared-tree/ (the public editing facade).
+
+TPU-native re-design: edits are path-addressed mark-list changesets
+(``changeset.py``); the per-client path runs the EditManager replay;
+the service-side batched path (totally ordered, no sandwich needed)
+runs in ``fluidframework_tpu.ops.tree_kernel``.
+
+Paths: a field is addressed by alternating (field_key, node_index)
+pairs ending in a field key, e.g. ``("children",)`` is the root field
+"children" and ``("children", 2, "items")`` is field "items" of the
+third root child.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional, Sequence
+
+from ...protocol.messages import SequencedMessage
+from ...runtime.shared_object import SharedObject
+from ...utils.events import EventEmitter
+from . import changeset as cs
+from .changeset import FieldChanges
+from .editmanager import Commit, EditManager
+from .forest import Forest, node
+
+
+def wrap_path(path: Sequence, leaf_marks: list) -> FieldChanges:
+    """Nest a mark list under a (field, index, field, index, ...) path
+    by wrapping it in ``mod`` marks."""
+    if len(path) % 2 != 1:
+        raise ValueError("path must end on a field key")
+    changes: FieldChanges = {path[-1]: leaf_marks}
+    for i in range(len(path) - 3, -1, -2):
+        key, idx = path[i], path[i + 1]
+        changes = {key: [cs.skip(idx), cs.mod(fields=changes)]
+                   if idx else [cs.mod(fields=changes)]}
+    return changes
+
+
+class SharedTree(SharedObject, EventEmitter):
+    type_name = "sharedtree"
+
+    def __init__(self, channel_id: str):
+        SharedObject.__init__(self, channel_id)
+        EventEmitter.__init__(self)
+        self._em = EditManager(session_id="detached")
+
+    # ------------------------------------------------------------------
+
+    def _on_connect(self) -> None:
+        if self.client_id:
+            self._em.session_id = self.client_id
+
+    # ------------------------------------------------------------------
+    # reading
+
+    @property
+    def forest(self) -> Forest:
+        return self._em.forest()
+
+    def root(self) -> dict:
+        """Canonical content: {field: [nodes]}."""
+        return self._em.forest().content()
+
+    def get_field(self, path: Sequence) -> list:
+        fields = self._em.forest().fields
+        i = 0
+        while i < len(path) - 1:
+            fields = fields[path[i]][path[i + 1]].get("fields", {})
+            i += 2
+        return fields.get(path[-1], [])
+
+    # ------------------------------------------------------------------
+    # editing (the sequence-field editor surface)
+
+    def insert_nodes(self, path: Sequence, index: int,
+                     content: list) -> None:
+        marks = ([cs.skip(index)] if index else []) + [cs.ins(content)]
+        self._apply_local(wrap_path(path, marks))
+
+    def delete_nodes(self, path: Sequence, index: int, count: int) -> None:
+        marks = ([cs.skip(index)] if index else []) + [cs.dele(count)]
+        self._apply_local(wrap_path(path, marks))
+
+    def set_value(self, path: Sequence, index: int, value: Any) -> None:
+        seq = self.get_field(path)
+        old = seq[index].get("value") if index < len(seq) else None
+        m = cs.mod(value={"new": value, "old": old})
+        marks = ([cs.skip(index)] if index else []) + [m]
+        self._apply_local(wrap_path(path, marks))
+
+    def apply_changeset(self, changes: FieldChanges) -> None:
+        """Escape hatch: submit a raw changeset."""
+        self._apply_local(copy.deepcopy(changes))
+
+    def _apply_local(self, changes: FieldChanges) -> None:
+        tag = self._em.add_local_change(changes)
+        self.submit_local_message({"type": "tree", "changes": changes},
+                                  metadata={"tag": tag})
+        self.emit("changed", local=True)
+
+    # ------------------------------------------------------------------
+    # SharedObject contract
+
+    def process_core(self, msg: SequencedMessage, local: bool,
+                     local_op_metadata: Any = None) -> None:
+        op = msg.contents
+        if not isinstance(op, dict) or op.get("type") != "tree":
+            raise ValueError(f"unexpected tree op: {op!r}")
+        commit = Commit(session_id=msg.client_id or "",
+                        seq=msg.sequence_number,
+                        ref_seq=msg.reference_sequence_number,
+                        changes=op["changes"])
+        self._em.add_sequenced_change(commit, is_local=local)
+        if msg.minimum_sequence_number > self._em.min_seq:
+            self._em.advance_minimum_sequence_number(
+                msg.minimum_sequence_number)
+        self.emit("changed", local=local)
+
+    def resubmit_core(self, contents: Any, metadata: Any = None) -> None:
+        """Reconnect rebase (sharedObject.ts:378): the EditManager keeps
+        local changes rebased against the trunk tip, so resubmit sends
+        the *current* form, found by its local revision tag."""
+        tag = (metadata or {}).get("tag")
+        for change, t in self._em.local_changes:
+            if t == tag:
+                self.submit_local_message({"type": "tree",
+                                           "changes": change},
+                                          metadata={"tag": tag})
+                return
+        # Unknown tag: the op was already sequenced; nothing to resend.
+
+    def apply_stashed_op(self, contents: Any) -> Any:
+        changes = contents["changes"]
+        tag = self._em.add_local_change(changes)
+        return {"tag": tag}
+
+    def summarize_core(self) -> dict:
+        """Forest index + edit-manager index
+        (sharedTreeCore.ts:73 summary composed of indexes)."""
+        em = self._em
+        return {
+            "forest": em.base_forest.content(),
+            # repair data for deletes already evicted into the base
+            # forest — without it a summary-loaded replica cannot honor
+            # rev marks older than min_seq and diverges from live ones
+            "repair": [[u, i, copy.deepcopy(n)]
+                       for (u, i), n in sorted(
+                           em.base_forest.repair.items(),
+                           key=lambda kv: (str(kv[0][0]), kv[0][1]))],
+            "trunk": [{"session": c.session_id, "seq": c.seq,
+                       "ref": c.ref_seq, "changes": c.changes}
+                      for c in em.trunk],
+            "min_seq": em.min_seq,
+        }
+
+    def load_core(self, summary: dict) -> None:
+        em = EditManager(session_id=self._em.session_id,
+                         base=Forest(copy.deepcopy(summary["forest"])))
+        for u, i, n in summary.get("repair", []):
+            em.base_forest.repair[(u, i)] = copy.deepcopy(n)
+        for c in summary["trunk"]:
+            em.trunk.append(Commit(c["session"], c["seq"], c["ref"],
+                                   c["changes"]))
+        em.min_seq = summary["min_seq"]
+        self._em = em
+
+    def signature(self) -> Any:
+        return self._em.forest().signature()

@@ -1,0 +1,402 @@
+"""SharedTree tests: changeset algebra laws + multi-client convergence.
+
+Mirrors the reference's rebase fuzz strategy
+(packages/dds/tree/src/test/rebase/generateFuzzyCombinedChange.spec.ts,
+sequenceChangeRebaser.fuzz.spec.ts — fuzzing the compose/invert/rebase
+laws from core/rebase/rebaser.ts:138-170) plus DDS-level convergence
+through the mock sequencer.
+"""
+import copy
+import random
+
+import pytest
+
+from fluidframework_tpu.models.tree import (
+    Commit,
+    EditManager,
+    Forest,
+    changeset as cs,
+    compose,
+    invert,
+    node,
+    rebase,
+    wrap_path,
+)
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+
+# ---------------------------------------------------------------------------
+# fuzz helpers
+
+def rand_node(rng, depth=0):
+    n = node(rng.choice(["a", "b", "c"]), value=rng.randrange(100))
+    if depth < 1 and rng.random() < 0.3:
+        n["fields"] = {"kids": [rand_node(rng, depth + 1)
+                                for _ in range(rng.randrange(1, 3))]}
+    return n
+
+
+def base_forest(rng, width=6):
+    return Forest({"root": [rand_node(rng) for _ in range(width)]})
+
+
+def rand_change(rng, forest):
+    """A random well-formed changeset against ``forest``."""
+    seq = forest.fields.get("root", [])
+    n = len(seq)
+    kind = rng.choice(["ins", "del", "mod"] if n else ["ins"])
+    if kind == "ins":
+        idx = rng.randrange(n + 1)
+        content = [rand_node(rng) for _ in range(rng.randrange(1, 3))]
+        marks = ([cs.skip(idx)] if idx else []) + [cs.ins(content)]
+    elif kind == "del":
+        idx = rng.randrange(n)
+        count = rng.randrange(1, min(3, n - idx) + 1)
+        marks = ([cs.skip(idx)] if idx else []) + [cs.dele(count)]
+    else:
+        idx = rng.randrange(n)
+        old = seq[idx].get("value")
+        m = cs.mod(value={"new": rng.randrange(100, 200), "old": old})
+        marks = ([cs.skip(idx)] if idx else []) + [m]
+    return {"root": marks}
+
+
+def applied(forest, *changes_revs):
+    f = forest.clone()
+    for changes, revision in changes_revs:
+        f.apply(changes, revision)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# algebra laws (rebaser.ts:138-170), checked by effect on a forest
+
+@pytest.mark.parametrize("seed", range(30))
+def test_invert_roundtrip(seed):
+    """apply(a) then apply(invert(a)) restores the forest."""
+    rng = random.Random(seed)
+    f = base_forest(rng)
+    a = rand_change(rng, f)
+    fa = applied(f, (a, 1))
+    back = applied(fa, (invert(a, 1), 2))
+    assert back.signature() == f.signature()
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_rebase_identity_laws(seed):
+    rng = random.Random(seed)
+    f = base_forest(rng)
+    a = rand_change(rng, f)
+    assert rebase(a, compose([])) == a or \
+        cs.normalize_fields(rebase(a, compose([]))) == \
+        cs.normalize_fields(a)
+    assert rebase(compose([]), a) in ({}, compose([]))
+
+
+@pytest.mark.parametrize("seed", range(60))
+def test_rebase_over_compose_law(seed):
+    """rebase(a, compose([b, c])) == rebase(rebase(a, b), c), compared
+    by effect on the post-b-c forest."""
+    rng = random.Random(seed)
+    f = base_forest(rng)
+    a = rand_change(rng, f)
+    b = rand_change(rng, f)
+    fb = applied(f, (b, 10))
+    c = rand_change(rng, fb)
+
+    lhs = rebase(a, compose([b, c]))
+    rhs = rebase(rebase(a, b), c)
+
+    fbc = applied(fb, (c, 11))
+    out_l = applied(fbc, (lhs, 12))
+    out_r = applied(fbc, (rhs, 12))
+    assert out_l.signature() == out_r.signature()
+
+
+@pytest.mark.parametrize("seed", range(60))
+def test_compose_matches_sequential_apply(seed):
+    rng = random.Random(seed)
+    f = base_forest(rng)
+    a = rand_change(rng, f)
+    fa = applied(f, (a, 1))
+    b = rand_change(rng, fa)
+    seq = applied(fa, (b, 2))
+    comp = applied(f, (compose([a, b]), 3))
+    assert seq.signature() == comp.signature()
+
+
+# ---------------------------------------------------------------------------
+# EditManager convergence (editManager.ts semantics)
+
+def em_pair(base=None):
+    return (EditManager("A", base), EditManager("B", base))
+
+
+def test_editmanager_concurrent_inserts_converge():
+    base = Forest({"root": [node("x", value=0)]})
+    ea, eb = em_pair(base)
+    ca = {"root": [cs.ins([node("fromA", value=1)])]}
+    cb = {"root": [cs.skip(1), cs.ins([node("fromB", value=2)])]}
+    ea.add_local_change(ca)
+    eb.add_local_change(cb)
+    # sequencer orders A's op first
+    ea.add_sequenced_change(Commit("A", 1, 0, ca))
+    eb.add_sequenced_change(Commit("A", 1, 0, ca))
+    ea.add_sequenced_change(Commit("B", 2, 0, cb))
+    eb.add_sequenced_change(Commit("B", 2, 0, cb))
+    assert ea.forest().signature() == eb.forest().signature()
+    types = [n["type"] for n in ea.forest().fields["root"]]
+    assert set(types) == {"fromA", "x", "fromB"}
+
+
+def test_editmanager_delete_vs_insert_converge():
+    base = Forest({"root": [node("x", value=i) for i in range(4)]})
+    ea, eb = em_pair(base)
+    ca = {"root": [cs.skip(1), cs.dele(2)]}       # A deletes [1,3)
+    cb = {"root": [cs.skip(2), cs.ins([node("new")])]}  # B inserts at 2
+    ea.add_local_change(ca)
+    eb.add_local_change(cb)
+    for em in (ea, eb):
+        em.add_sequenced_change(Commit("A", 1, 0, ca))
+        em.add_sequenced_change(Commit("B", 2, 0, cb))
+    assert ea.forest().signature() == eb.forest().signature()
+    # B's insert survives, anchored at the collapse point
+    assert any(n["type"] == "new" for n in ea.forest().fields["root"])
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_editmanager_fuzz_convergence(seed):
+    """N clients make concurrent random edits; a mock sequencer orders
+    them; all trunks/forests converge."""
+    rng = random.Random(1000 + seed)
+    base = base_forest(rng)
+    sessions = ["A", "B", "C"]
+    ems = {s: EditManager(s, base) for s in sessions}
+    seq_num = 0
+    for round_i in range(6):
+        # each client authors 0-2 changes against its current view
+        # (all commits from prior rounds delivered, so ref = seq_num)
+        ref = seq_num
+        queues = {}
+        for s in sessions:
+            for _ in range(rng.randrange(0, 3)):
+                change = rand_change_generic(rng, ems[s].forest())
+                ems[s].add_local_change(change)
+                queues.setdefault(s, []).append(change)
+        # random interleave preserving each session's FIFO (the real
+        # sequencer never reorders one client's ops)
+        staged = []
+        while queues:
+            s = rng.choice(sorted(queues))
+            staged.append((s, queues[s].pop(0)))
+            if not queues[s]:
+                del queues[s]
+        for s, change in staged:
+            seq_num += 1
+            for t in sessions:
+                ems[t].add_sequenced_change(
+                    Commit(s, seq_num, ref, change),
+                    is_local=(t == s))
+    sigs = {s: ems[s].forest().signature() for s in sessions}
+    assert len(set(sigs.values())) == 1, sigs
+
+
+def rand_change_generic(rng, forest):
+    return rand_change(rng, forest)
+
+
+# ---------------------------------------------------------------------------
+# DDS-level tests through the container session
+
+def make(n=2):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    s = ContainerSession(ids)
+    for cid in ids:
+        s.runtime(cid).create_datastore("d").create_channel(
+            "sharedtree", "t")
+    return s, ids
+
+
+def tree(s, cid):
+    return s.runtime(cid).get_datastore("d").get_channel("t")
+
+
+def test_tree_basic_edit_and_converge():
+    s, _ = make()
+    a = tree(s, "A")
+    a.insert_nodes(("root",), 0, [node("n", value=1), node("n", value=2)])
+    s.process_all()
+    s.assert_converged()
+    b = tree(s, "B")
+    assert [n["value"] for n in b.get_field(("root",))] == [1, 2]
+
+
+def test_tree_concurrent_edits_converge():
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    a.insert_nodes(("root",), 0, [node("base", value=0)])
+    s.process_all()
+    a.insert_nodes(("root",), 1, [node("fromA", value=1)])
+    b.set_value(("root",), 0, 99)
+    b.insert_nodes(("root",), 0, [node("fromB", value=2)])
+    s.process_all()
+    s.assert_converged()
+    vals = [n["type"] for n in a.get_field(("root",))]
+    assert "fromA" in vals and "fromB" in vals
+
+
+def test_tree_nested_fields():
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    a.insert_nodes(("root",), 0, [node("parent")])
+    s.process_all()
+    a.insert_nodes(("root", 0, "kids"), 0, [node("kid", value=1)])
+    b.insert_nodes(("root", 0, "kids"), 0, [node("kid", value=2)])
+    s.process_all()
+    s.assert_converged()
+    kids = a.get_field(("root", 0, "kids"))
+    assert sorted(k["value"] for k in kids) == [1, 2]
+
+
+def test_tree_summary_roundtrip():
+    s, ids = make()
+    a = tree(s, "A")
+    a.insert_nodes(("root",), 0, [node("n", value=i) for i in range(3)])
+    a.delete_nodes(("root",), 1, 1)
+    s.process_all()
+    summary = a.summarize_core()
+    from fluidframework_tpu.models.tree import SharedTree
+    fresh = SharedTree("t2")
+    fresh.load_core(copy.deepcopy(summary))
+    assert fresh.signature() == a.signature()
+
+
+def test_tree_reconnect_resubmits_rebased():
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    a.insert_nodes(("root",), 0, [node("x", value=i) for i in range(3)])
+    s.process_all()
+    s.disconnect("A")
+    a.delete_nodes(("root",), 2, 1)          # offline edit
+    b.insert_nodes(("root",), 0, [node("y")])  # concurrent peer edit
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    s.assert_converged()
+    types = [n["type"] for n in b.get_field(("root",))]
+    assert types.count("x") == 2 and "y" in types
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_tree_dds_fuzz(seed):
+    s, ids = make(3)
+    rng = random.Random(seed)
+    trees = {cid: tree(s, cid) for cid in ids}
+    trees["A"].insert_nodes(("root",), 0,
+                            [node("seed", value=i) for i in range(4)])
+    s.process_all()
+    for _ in range(20):
+        cid = rng.choice(ids)
+        t = trees[cid]
+        f = t.get_field(("root",))
+        n = len(f)
+        op = rng.choice(["ins", "del", "set", "proc"])
+        if op == "ins":
+            t.insert_nodes(("root",), rng.randrange(n + 1),
+                           [node("n", value=rng.randrange(100))])
+        elif op == "del" and n:
+            t.delete_nodes(("root",), rng.randrange(n), 1)
+        elif op == "set" and n:
+            t.set_value(("root",), rng.randrange(n), rng.randrange(100))
+        else:
+            s.process_some(rng.randrange(1, 4))
+    s.process_all()
+    s.assert_converged()
+
+
+# ---------------------------------------------------------------------------
+# collab-window eviction + summary repair (regression: code review r1)
+
+def test_eviction_preserves_branch_rebasing():
+    """Trunk eviction must fast-forward lazy peer branches first, or a
+    later branch commit rebases over an incomplete trunk window.
+    Authoring uses per-client delivery so every commit's ref matches
+    the view it was actually authored against."""
+    base = Forest({"root": [node("x", value=i) for i in range(6)]})
+    sessions = ["A", "B", "C"]
+    ems = {s: EditManager(s, base) for s in sessions}
+    log: list[Commit] = []
+    delivered = {s: 0 for s in sessions}
+
+    def author(s, change):
+        ems[s].add_local_change(change)
+        log.append(Commit(s, len(log) + 1, delivered[s], change))
+
+    def deliver_all():
+        for s in sessions:
+            while delivered[s] < len(log):
+                c = log[delivered[s]]
+                ems[s].add_sequenced_change(
+                    Commit(c.session_id, c.seq, c.ref_seq,
+                           copy.deepcopy(c.changes)),
+                    is_local=(c.session_id == s))
+                delivered[s] = c.seq
+
+    author("B", {"root": [cs.ins([node("b1")])]})              # seq1 ref0
+    deliver_all()
+    author("A", {"root": [cs.skip(3), cs.ins([node("a1")])]})  # seq2 ref1
+    # B authors concurrently, before seeing seq2 (ref stays 1)
+    author("B", {"root": [cs.skip(4), cs.ins([node("b2")])]})  # seq3 ref1
+    deliver_all()
+    author("A", {"root": [cs.skip(1), cs.dele(2)]})            # seq4 ref3
+    deliver_all()
+    # collab window advances past seqs 1-3 on every replica; B's branch
+    # at its peers is still based at ref 1
+    for em in ems.values():
+        em.advance_minimum_sequence_number(4)
+    # the fix's invariant: no branch may be based below the eviction
+    # point, since _update_branch can only rebase over surviving trunk
+    for em in ems.values():
+        for branch in em.branches.values():
+            assert branch.ref_seq >= 3, branch
+            assert all(c.seq >= 4 for c in branch.local_changes)
+    # positioned past b2 so a mis-rebased branch window would misplace it
+    author("B", {"root": [cs.skip(6), cs.ins([node("b3")])]})  # seq5 ref4
+    deliver_all()
+    sigs = {em.forest().signature() for em in ems.values()}
+    assert len(sigs) == 1, sigs
+    types = [n["type"] for n in ems["A"].forest().fields["root"]]
+    assert {"b1", "a1", "b2", "b3"} <= set(types)
+
+
+def test_summary_preserves_repair_for_old_revives():
+    """A summary-loaded replica must honor rev marks pointing at deletes
+    already evicted into the base forest."""
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    a.insert_nodes(("root",), 0, [node("x", value=1), node("x", value=2)])
+    s.process_all()
+    a.delete_nodes(("root",), 0, 1)
+    s.process_all()
+    # find the delete's birth identity from A's trunk form
+    trunk = a.summarize_core()["trunk"]
+    del_mark = next(m for c in trunk for m in c["changes"].get("root", [])
+                    if m["t"] == "del")
+    u, i = del_mark["did"]
+    # force eviction, then snapshot
+    a._em.advance_minimum_sequence_number(a._em.trunk[-1].seq + 1)
+    summary = copy.deepcopy(a.summarize_core())
+    from fluidframework_tpu.models.tree import SharedTree
+    fresh = SharedTree("t2")
+    fresh.load_core(summary)
+    # a client undoes the old delete via a revive changeset
+    undo = {"root": [cs.rev(1, u, i)]}
+    a._em.add_sequenced_change(
+        Commit("C", a._em.trunk[-1].seq + 1 if a._em.trunk else 99, 0, undo),
+        is_local=False)
+    fresh._em.add_sequenced_change(
+        Commit("C", (fresh._em.trunk[-1].seq + 1) if fresh._em.trunk else 99,
+               0, undo), is_local=False)
+    assert fresh.signature() == a.signature()
+    vals = [n["value"] for n in fresh._em.forest().fields["root"]]
+    assert 1 in vals
