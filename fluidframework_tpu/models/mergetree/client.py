@@ -15,7 +15,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ...protocol.constants import UNASSIGNED_SEQ
-from ...protocol.messages import SequencedMessage
+from ...protocol.messages import MessageType, SequencedMessage
 from .mergetree import MergeTree
 from .ops import AnnotateOp, DeltaType, GroupOp, InsertOp, RemoveOp
 from .segments import Segment
@@ -170,6 +170,13 @@ class MergeTreeClient:
     # sequenced stream (client.ts applyMsg :918)
 
     def apply_msg(self, msg: SequencedMessage) -> None:
+        if msg.type != MessageType.OPERATION:
+            # System messages (join/leave/propose/noop) carry no
+            # merge-tree op but still advance the collab window —
+            # mirrors updateSeqNumbers running for every sequenced
+            # message while applyMsg (client.ts:918) only sees ops.
+            self._update_seq_numbers(msg)
+            return
         op = msg.contents
         if msg.client_id == self.long_client_id:
             self._ack_own(op, msg)

@@ -115,8 +115,10 @@ class MockCollabSession:
                 ep.missed.append(msg)
                 continue
             ep.last_seen_seq = msg.sequence_number
-            if msg.type == MessageType.OPERATION:
-                ep.client.apply_msg(msg)
+            # Full stream, including system messages: apply_msg advances
+            # the collab window on non-ops, matching the kernel's
+            # min-seq-advancing NOOP encoding (ops/host_bridge.py).
+            ep.client.apply_msg(msg)
 
     # ------------------------------------------------------------------
     # reconnect (mocksForReconnection.ts:19,104 + §3.5)
@@ -146,8 +148,7 @@ class MockCollabSession:
         assert not ep.connected, "not disconnected"
         for msg in ep.missed:
             ep.last_seen_seq = msg.sequence_number
-            if msg.type == MessageType.OPERATION:
-                ep.client.apply_msg(msg)
+            ep.client.apply_msg(msg)
         ep.missed.clear()
         ep.connected = True
         join = self.sequencer.client_join(ClientDetail(client_id))

@@ -20,7 +20,6 @@ from fluidframework_tpu.ops import (
     fetch,
     make_table,
 )
-from fluidframework_tpu.protocol.messages import MessageType
 from fluidframework_tpu.testing import FuzzConfig, record_op_stream
 
 
@@ -29,8 +28,7 @@ def oracle_replay(stream):
     obs = MergeTreeClient("kernel-observer")
     obs.start_collaboration("kernel-observer")
     for msg in stream:
-        if msg.type == MessageType.OPERATION:
-            obs.apply_msg(msg)
+        obs.apply_msg(msg)
     return obs
 
 
