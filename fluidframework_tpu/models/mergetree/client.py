@@ -346,6 +346,25 @@ class MergeTreeClient:
         return regenerated
 
     # ------------------------------------------------------------------
+    # local references (cursor/interval anchors)
+
+    def create_reference(self, pos: int, ref_type: int,
+                         view_of: Optional[SequencedMessage] = None):
+        """Anchor a local reference at ``pos``. With ``view_of`` given,
+        the position is interpreted at that message's (refSeq, sender)
+        view — how remote interval endpoints resolve."""
+        if view_of is None:
+            return self.mergetree.create_local_reference(pos, ref_type)
+        return self.mergetree.create_local_reference(
+            pos, ref_type,
+            refseq=view_of.reference_sequence_number,
+            client_id=self.intern(view_of.client_id),
+        )
+
+    def reference_position(self, ref) -> int:
+        return self.mergetree.reference_position(ref)
+
+    # ------------------------------------------------------------------
     # queries
 
     def get_text(self) -> str:

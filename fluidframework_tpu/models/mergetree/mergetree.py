@@ -25,6 +25,12 @@ from __future__ import annotations
 from typing import Optional
 
 from ...protocol.constants import MAX_SEQ, NON_COLLAB_CLIENT, UNASSIGNED_SEQ
+from .localref import (
+    DETACHED_POSITION,
+    LocalReference,
+    attach_reference,
+)
+from .ops import ReferenceType
 from .segments import CollabWindow, Segment
 
 
@@ -365,11 +371,41 @@ class MergeTree:
 
     def zamboni(self) -> None:
         """Drop tombstones below the window; merge adjacent segments
-        fully below the window. Never touches pending segments."""
+        fully below the window. Never touches pending segments. Local
+        references on dropped tombstones transfer to their slide target
+        first (localReference semantics, localReference.ts:139)."""
         min_seq = self.collab.min_seq
+        segs = self.segments
+        dropped = [
+            seg.removal_acked and seg.removed_seq <= min_seq
+            for seg in segs
+        ]
+        for i, seg in enumerate(segs):
+            if not dropped[i] or not seg.local_refs:
+                continue
+            target: Optional[Segment] = None
+            t_off = 0
+            for j in range(i + 1, len(segs)):  # forward slide first
+                if not dropped[j]:
+                    target, t_off = segs[j], 0
+                    break
+            if target is None:
+                for j in range(i - 1, -1, -1):  # then backward
+                    if not dropped[j]:
+                        target = segs[j]
+                        t_off = max(target.length - 1, 0)
+                        break
+            for ref in seg.local_refs:
+                if target is None:
+                    ref.detach()
+                else:
+                    ref.segment = target
+                    ref.offset = t_off
+                    target.local_refs.append(ref)
+            seg.local_refs = []
         out: list[Segment] = []
-        for seg in self.segments:
-            if seg.removal_acked and seg.removed_seq <= min_seq:
+        for i, seg in enumerate(segs):
+            if dropped[i]:
                 continue  # every view has seen this removal
             prev = out[-1] if out else None
             if (
@@ -378,6 +414,13 @@ class MergeTree:
                 and self._zamboni_mergeable(seg, min_seq)
                 and prev.can_append(seg)
             ):
+                if seg.local_refs:
+                    shift = len(prev.text)
+                    for ref in seg.local_refs:
+                        ref.segment = prev
+                        ref.offset += shift
+                    prev.local_refs.extend(seg.local_refs)
+                    seg.local_refs = []
                 prev.text = prev.text + seg.text
                 prev.seq = max(prev.seq, seg.seq)
             else:
@@ -419,6 +462,79 @@ class MergeTree:
             if length and seg.text is not None:
                 parts.append(seg.text)
         return "".join(parts)
+
+    def segment_at(
+        self,
+        pos: int,
+        refseq: Optional[int] = None,
+        client_id: Optional[int] = None,
+    ) -> tuple[Segment, int]:
+        """(segment, offset) containing position ``pos`` at a view
+        (getContainingSegment, mergeTree.ts)."""
+        refseq = self.collab.current_seq if refseq is None else refseq
+        client_id = self.collab.client_id if client_id is None else client_id
+        remaining = pos
+        for seg in self.segments:
+            length = self._length_at(seg, refseq, client_id)
+            if not length:
+                continue
+            if remaining < length:
+                return seg, remaining
+            remaining -= length
+        raise ValueError(
+            f"position {pos} beyond view length (refseq={refseq}, "
+            f"client={client_id})"
+        )
+
+    # ------------------------------------------------------------------
+    # local references (localReference.ts:44,139)
+
+    def create_local_reference(
+        self,
+        pos: int,
+        ref_type: int = ReferenceType.SLIDE_ON_REMOVE,
+        properties: Optional[dict] = None,
+        refseq: Optional[int] = None,
+        client_id: Optional[int] = None,
+    ) -> LocalReference:
+        """Anchor a sliding reference at ``pos`` resolved at a view
+        (the sender's view for remote interval ops)."""
+        seg, offset = self.segment_at(pos, refseq, client_id)
+        ref = LocalReference(None, 0, ref_type, properties)
+        attach_reference(ref, seg, offset)
+        return ref
+
+    def reference_position(self, ref: LocalReference) -> int:
+        """Current document position of a local reference, applying
+        slide-on-remove resolution (localReferencePositionToPosition)."""
+        seg = ref.segment
+        if seg is None:
+            return DETACHED_POSITION
+        cur = self.collab.current_seq
+        viewer = self.collab.client_id
+        length = self._length_at(seg, cur, viewer)
+        if length:
+            try:
+                return self.get_offset(seg, cur, viewer) + ref.offset
+            except ValueError:
+                # transient refs aren't registered on segments, so a
+                # zamboni merge can orphan their anchor silently
+                return DETACHED_POSITION
+        # Anchor is a tombstone (or invisible) in our current view.
+        if not (ref.slides or ref.stays):
+            if seg.removal_acked:
+                return DETACHED_POSITION
+            # local-pending remove: still resolves at the tombstone
+        try:
+            forward = self.get_offset(seg, cur, viewer)
+        except ValueError:
+            return DETACHED_POSITION  # orphaned anchor (transient ref)
+        total = self.length_at(cur, viewer)
+        if forward < total:
+            return forward  # slid to the next surviving position
+        if total == 0:
+            return DETACHED_POSITION
+        return total - 1  # nothing after: slide backward to last position
 
     def get_offset(
         self,

@@ -50,6 +50,10 @@ class Segment:
     # sync across splits (client.ts segment groups)
     groups: list = field(default_factory=list)
 
+    # local references anchored here (localReference.ts:139); these
+    # follow splits and slide on removal/zamboni — see mergetree.py
+    local_refs: list = field(default_factory=list)
+
     @property
     def length(self) -> int:
         if self.text is not None:
@@ -96,6 +100,16 @@ class Segment:
         self.text = self.text[:offset]
         for group in self.groups:
             group.segments.append(tail)
+        # references at/after the split point move to the tail
+        keep, move = [], []
+        for ref in self.local_refs:
+            (move if ref.offset >= offset else keep).append(ref)
+        if move:
+            self.local_refs = keep
+            for ref in move:
+                ref.segment = tail
+                ref.offset -= offset
+                tail.local_refs.append(ref)
         return tail
 
     def can_append(self, other: "Segment") -> bool:

@@ -13,6 +13,7 @@ from ..protocol.constants import UNASSIGNED_SEQ
 from ..protocol.messages import SequencedMessage
 from ..runtime.shared_object import SharedObject
 from ..utils.events import EventEmitter
+from .intervals import IntervalCollection, IntervalOp
 from .mergetree import MergeTreeClient
 from .mergetree.segments import Segment
 
@@ -25,6 +26,7 @@ class SharedString(SharedObject, EventEmitter):
         EventEmitter.__init__(self)
         self.client = MergeTreeClient()
         self._resubmit_epoch = -1
+        self._interval_collections: dict[str, IntervalCollection] = {}
 
     # ------------------------------------------------------------------
 
@@ -65,11 +67,44 @@ class SharedString(SharedObject, EventEmitter):
         return self.client.get_length()
 
     # ------------------------------------------------------------------
+    # interval collections (sequence/src/intervalCollection.ts:1309)
+
+    def get_interval_collection(self, label: str) -> IntervalCollection:
+        coll = self._interval_collections.get(label)
+        if coll is None:
+            coll = IntervalCollection(
+                label, self.client, self.submit_local_message
+            )
+            self._interval_collections[label] = coll
+        return coll
+
+    def create_position_reference(self, pos: int, ref_type: int):
+        """Public cursor-anchor API (sharedString createLocalReference
+        passthrough)."""
+        return self.client.create_reference(pos, ref_type)
+
+    def local_reference_position(self, ref) -> int:
+        return self.client.reference_position(ref)
+
+    # ------------------------------------------------------------------
     # SharedObject contract
 
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
         assert local == (msg.client_id == self.client.long_client_id)
+        if isinstance(msg.contents, IntervalOp):
+            op = msg.contents
+            coll = self.get_interval_collection(op.label)
+            coll.process(op, msg, local)
+            # interval ops still advance the merge-tree collab window
+            # (they are sequence ops; window advance keeps ref views
+            # and zamboni in step with the channel stream)
+            self.client.mergetree.update_min_seq(
+                msg.minimum_sequence_number
+            )
+            self.client.mergetree._advance(msg.sequence_number)
+            self.emit("intervalDelta", msg, local)
+            return
         self.client.apply_msg(msg)
         self.emit("sequenceDelta", msg, local)
 
@@ -86,6 +121,11 @@ class SharedString(SharedObject, EventEmitter):
         )
         for op in self.client.regenerate_pending_ops():
             self.submit_local_message(op)
+        # Interval ops resubmit after text ops: their regenerated
+        # positions are expressed against the post-rebase local view.
+        for coll in self._interval_collections.values():
+            for iop in coll.regenerate_pending_ops():
+                self.submit_local_message(iop)
 
     def signature(self):
         """Per-position (char|marker, props) content signature."""
@@ -102,7 +142,12 @@ class SharedString(SharedObject, EventEmitter):
                 out.append(("M", seg.marker["refType"], props))
             else:
                 out.extend((ch, props) for ch in seg.text)
-        return tuple(out)
+        intervals = tuple(
+            (label, coll.signature())
+            for label, coll in sorted(self._interval_collections.items())
+            if len(coll)
+        )
+        return (tuple(out), intervals)
 
     # ------------------------------------------------------------------
     # summary (SnapshotV1 simplified: snapshotV1.ts:36)
@@ -134,6 +179,11 @@ class SharedString(SharedObject, EventEmitter):
             "segments": segments,
             "minSeq": tree.collab.min_seq,
             "currentSeq": tree.collab.current_seq,
+            "intervals": {
+                label: coll.summarize()
+                for label, coll in self._interval_collections.items()
+                if len(coll)
+            },
         }
 
     def load_core(self, summary: dict) -> None:
@@ -154,3 +204,5 @@ class SharedString(SharedObject, EventEmitter):
                 props=dict(entry["props"]) if entry["props"] else None,
             )
             tree.segments.append(seg)
+        for label, entries in summary.get("intervals", {}).items():
+            self.get_interval_collection(label).load(entries)

@@ -1,0 +1,315 @@
+"""Local references + interval collections.
+
+Mirrors packages/dds/sequence/src/test/intervalCollection.spec.ts and
+merge-tree localReference tests: endpoints slide under concurrent edits,
+delete-wins, pending-local-wins, reconnect rebase, convergence.
+"""
+import pytest
+
+from fluidframework_tpu.models.mergetree import MergeTreeClient
+from fluidframework_tpu.models.mergetree.localref import DETACHED_POSITION
+from fluidframework_tpu.models.mergetree.ops import ReferenceType
+from fluidframework_tpu.testing import MockCollabSession
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+
+# ----------------------------------------------------------------------
+# local references on a single client
+
+def make_client(text="hello world"):
+    c = MergeTreeClient("A")
+    c.start_collaboration("A")
+    c.insert_text_local(0, text)
+    return c
+
+
+def test_reference_tracks_position_under_inserts():
+    c = make_client("abcdef")
+    ref = c.create_reference(3, ReferenceType.SLIDE_ON_REMOVE)  # at 'd'
+    assert c.reference_position(ref) == 3
+    c.insert_text_local(0, "XY")  # shift right by 2
+    assert c.reference_position(ref) == 5
+    c.insert_text_local(8, "tail")  # after the ref: no move
+    assert c.reference_position(ref) == 5
+
+
+def test_reference_survives_segment_split():
+    c = make_client("abcdef")
+    ref = c.create_reference(4, ReferenceType.SLIDE_ON_REMOVE)  # at 'e'
+    c.insert_text_local(2, "--")  # splits the abcdef segment
+    assert c.reference_position(ref) == 6
+    assert c.get_text() == "ab--cdef"
+
+
+def test_reference_slides_forward_on_remove():
+    """SlideOnRemove: anchor removed -> resolve to next surviving
+    position (localReference.ts slide semantics)."""
+    s, _ = make(2)
+    a = s.client("A")
+    s.do("A", "insert_text_local", 0, "abcdef")
+    s.process_all()
+    ref = a.create_reference(2, ReferenceType.SLIDE_ON_REMOVE)  # 'c'
+    s.do("B", "remove_range_local", 1, 4)  # removes bcd
+    s.process_all()
+    assert a.get_text() == "aef"
+    assert a.reference_position(ref) == 1  # slid to 'e'
+
+
+def test_reference_slides_backward_at_document_end():
+    s, _ = make(2)
+    a = s.client("A")
+    s.do("A", "insert_text_local", 0, "abc")
+    s.process_all()
+    ref = a.create_reference(2, ReferenceType.SLIDE_ON_REMOVE)  # 'c'
+    s.do("B", "remove_range_local", 1, 3)  # removes bc, nothing after
+    s.process_all()
+    assert a.get_text() == "a"
+    assert a.reference_position(ref) == 0  # slid backward to 'a'
+
+
+def test_simple_reference_detaches_on_remove():
+    s, _ = make(2)
+    a = s.client("A")
+    s.do("A", "insert_text_local", 0, "abc")
+    s.process_all()
+    ref = a.create_reference(1, ReferenceType.SIMPLE)
+    s.do("B", "remove_range_local", 0, 3)
+    s.process_all()
+    assert a.reference_position(ref) == DETACHED_POSITION
+
+
+def test_reference_survives_zamboni_compaction():
+    """When the tombstone is compacted below the collab window, the
+    reference transfers to its slide target and keeps resolving."""
+    s, _ = make(2)
+    a = s.client("A")
+    s.do("A", "insert_text_local", 0, "abcdef")
+    s.process_all()
+    ref = a.create_reference(2, ReferenceType.SLIDE_ON_REMOVE)
+    s.do("B", "remove_range_local", 1, 4)
+    s.process_all()
+    # advance the window far enough for zamboni with noop-ish traffic
+    for _ in range(3):
+        s.do("A", "insert_text_local", a.get_length(), "x")
+        s.process_all()
+        s.do("B", "insert_text_local", 0, "y")
+        s.process_all()
+    assert a.reference_position(ref) is not None
+    pos = a.reference_position(ref)
+    assert a.get_text()[pos] == "e"
+
+
+def make(n=2):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    return MockCollabSession(ids), ids
+
+
+# ----------------------------------------------------------------------
+# interval collections over container runtimes
+
+def make_session(n=2):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    s = ContainerSession(ids)
+    for cid in ids:
+        ds = s.runtime(cid).create_datastore("ds")
+        ds.create_channel("sharedstring", "text")
+    return s, ids
+
+
+def strings(s, ids):
+    return [
+        s.runtime(cid).get_datastore("ds").get_channel("text")
+        for cid in ids
+    ]
+
+
+def test_interval_add_converges():
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "hello world")
+    s.process_all()
+    sa.get_interval_collection("comments").add(0, 4, {"author": "A"})
+    s.process_all()
+    cb = sb.get_interval_collection("comments")
+    assert len(cb) == 1
+    iv = next(iter(cb))
+    assert cb.endpoints(iv) == (0, 4)
+    assert iv.props == {"author": "A"}
+    assert sa.signature() == sb.signature()
+
+
+def test_interval_slides_under_concurrent_text_edit():
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "hello world")
+    s.process_all()
+    # A intervals "world" while B inserts at the front concurrently
+    ca = sa.get_interval_collection("c")
+    ca.add(6, 10)
+    sb.insert_text(0, ">> ")
+    s.process_all()
+    for ss in (sa, sb):
+        coll = ss.get_interval_collection("c")
+        iv = next(iter(coll))
+        assert coll.endpoints(iv) == (9, 13)
+        start, end = coll.endpoints(iv)
+        assert ss.get_text()[start:end + 1] == "world"
+
+
+def test_interval_endpoint_slides_when_text_removed():
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "abcdefgh")
+    s.process_all()
+    ca = sa.get_interval_collection("c")
+    ca.add(2, 5)  # c..f
+    s.process_all()
+    sb.remove_text(0, 4)  # removes abcd; start anchor 'c' gone
+    s.process_all()
+    for ss in (sa, sb):
+        coll = ss.get_interval_collection("c")
+        iv = next(iter(coll))
+        assert coll.endpoints(iv) == (0, 1)  # slid to 'e', end 'f'
+    assert sa.signature() == sb.signature()
+
+
+def test_interval_delete_wins_over_concurrent_change():
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "0123456789")
+    s.process_all()
+    ca = sa.get_interval_collection("c")
+    iv = ca.add(1, 3)
+    s.process_all()
+    # A deletes while B concurrently changes
+    ca.delete(iv.interval_id)
+    sb.get_interval_collection("c").change(iv.interval_id, start=5, end=7)
+    s.process_all()
+    assert len(sa.get_interval_collection("c")) == 0
+    assert len(sb.get_interval_collection("c")) == 0
+    assert sa.signature() == sb.signature()
+
+
+def test_interval_concurrent_change_lww():
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "0123456789")
+    s.process_all()
+    iv = sa.get_interval_collection("c").add(0, 1)
+    s.process_all()
+    # both change concurrently; B's op sequences second -> B wins
+    sa.get_interval_collection("c").change(iv.interval_id, start=2, end=3)
+    sb.get_interval_collection("c").change(iv.interval_id, start=6, end=7)
+    s.flush("A")
+    s.flush("B")
+    s.process_all()
+    for ss in (sa, sb):
+        coll = ss.get_interval_collection("c")
+        got = coll.endpoints(next(iter(coll)))
+        assert got == (6, 7), got
+    assert sa.signature() == sb.signature()
+
+
+def test_interval_pending_local_change_wins_until_ack():
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "0123456789")
+    s.process_all()
+    iv = sa.get_interval_collection("c").add(0, 1)
+    s.process_all()
+    # B's change sequences first; A has a pending local change and must
+    # keep its own value until the ack (then A's own op, sequenced
+    # later, wins everywhere)
+    sb.get_interval_collection("c").change(iv.interval_id, start=6, end=7)
+    s.flush("B")
+    sa.get_interval_collection("c").change(iv.interval_id, start=2, end=3)
+    s.flush("A")
+    ca = sa.get_interval_collection("c")
+    assert ca.endpoints(next(iter(ca))) == (2, 3)
+    s.process_all()
+    for ss in (sa, sb):
+        coll = ss.get_interval_collection("c")
+        assert coll.endpoints(next(iter(coll))) == (2, 3)
+    assert sa.signature() == sb.signature()
+
+
+def test_interval_concurrent_prop_changes_merge_per_key():
+    """Pending-wins is per aspect: A's pending prop 'a' must not drop
+    B's concurrent change to prop 'b' (or B's endpoint change)."""
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "0123456789")
+    s.process_all()
+    iv = sa.get_interval_collection("c").add(0, 1)
+    s.process_all()
+    # B changes prop b and endpoints; sequences first
+    sb.get_interval_collection("c").change(
+        iv.interval_id, start=4, end=5, props={"b": 2}
+    )
+    s.flush("B")
+    # A concurrently changes only prop a (no endpoints)
+    sa.get_interval_collection("c").change(iv.interval_id, props={"a": 1})
+    s.flush("A")
+    s.process_all()
+    for ss in (sa, sb):
+        coll = ss.get_interval_collection("c")
+        got = next(iter(coll))
+        assert got.props == {"a": 1, "b": 2}, (ss, got.props)
+        assert coll.endpoints(got) == (4, 5)
+    assert sa.signature() == sb.signature()
+
+
+def test_find_overlapping():
+    s, ids = make_session(1)
+    (sa,) = strings(s, ids)
+    sa.insert_text(0, "0123456789")
+    s.process_all()
+    coll = sa.get_interval_collection("c")
+    coll.add(0, 2)
+    coll.add(4, 6)
+    coll.add(8, 9)
+    s.process_all()
+    hits = coll.find_overlapping(1, 5)
+    spans = sorted(coll.endpoints(iv) for iv in hits)
+    assert spans == [(0, 2), (4, 6)]
+
+
+def test_interval_summary_roundtrip():
+    s, ids = make_session(1)
+    (sa,) = strings(s, ids)
+    sa.insert_text(0, "hello world")
+    coll = sa.get_interval_collection("c")
+    coll.add(6, 10, {"k": "v"})
+    s.process_all()
+    summary = sa.summarize_core()
+
+    from fluidframework_tpu.models.sharedstring import SharedString
+    fresh = SharedString("text")
+    fresh.load_core(summary)
+    assert fresh.get_text() == "hello world"
+    lc = fresh.get_interval_collection("c")
+    assert len(lc) == 1
+    iv = next(iter(lc))
+    assert lc.endpoints(iv) == (6, 10)
+    assert iv.props == {"k": "v"}
+
+
+def test_interval_reconnect_resubmits_pending_adds():
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "hello world")
+    s.process_all()
+    s.disconnect("A")
+    # A adds an interval while offline; B edits text meanwhile
+    sa.get_interval_collection("c").add(6, 10)
+    sb.insert_text(0, ">> ")
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    for ss in (sa, sb):
+        coll = ss.get_interval_collection("c")
+        assert len(coll) == 1, ss
+        iv = next(iter(coll))
+        start, end = coll.endpoints(iv)
+        assert ss.get_text()[start:end + 1] == "world"
+    assert sa.signature() == sb.signature()
