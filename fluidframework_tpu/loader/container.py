@@ -148,10 +148,12 @@ class Container(EventEmitter):
         self.protocol.process_message(msg)
         if msg.type == MessageType.OPERATION:
             self.runtime.process(msg)
-        elif msg.type == MessageType.SUMMARY_ACK:
-            self.emit("summaryAck", msg.contents)
-        elif msg.type == MessageType.SUMMARY_NACK:
-            self.emit("summaryNack", msg.contents)
+        else:
+            self.runtime.observe_system(msg)
+            if msg.type == MessageType.SUMMARY_ACK:
+                self.emit("summaryAck", msg.contents)
+            elif msg.type == MessageType.SUMMARY_NACK:
+                self.emit("summaryNack", msg.contents)
         self.emit("processed", msg)
 
     def _on_nack(self, nack: Nack) -> None:

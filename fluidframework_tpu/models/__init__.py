@@ -4,10 +4,19 @@ Reference analogue: packages/dds/*.
 """
 from ..runtime.shared_object import ChannelRegistry, simple_factory
 from .cell import SharedCell
+from .consensus import (
+    ConsensusOrderedCollection,
+    ConsensusRegisterCollection,
+)
 from .counter import SharedCounter
+from .ink import Ink
+from .intervals import IntervalCollection, SequenceInterval
 from .map import MapKernel, SharedDirectory, SharedMap
 from .matrix import SharedMatrix
+from .quorum_dds import SharedQuorum
 from .sharedstring import SharedString
+from .summaryblock import SharedSummaryBlock
+from .taskmanager import TaskManager
 from .tree import SharedTree
 
 
@@ -22,17 +31,31 @@ def default_registry() -> ChannelRegistry:
         simple_factory(SharedCell),
         simple_factory(SharedCounter),
         simple_factory(SharedTree),
+        simple_factory(ConsensusRegisterCollection),
+        simple_factory(ConsensusOrderedCollection),
+        simple_factory(TaskManager),
+        simple_factory(SharedQuorum),
+        simple_factory(Ink),
+        simple_factory(SharedSummaryBlock),
     ])
 
 
 __all__ = [
+    "ConsensusOrderedCollection",
+    "ConsensusRegisterCollection",
+    "Ink",
+    "IntervalCollection",
     "MapKernel",
+    "SequenceInterval",
     "SharedCell",
     "SharedCounter",
     "SharedDirectory",
     "SharedMap",
     "SharedMatrix",
+    "SharedQuorum",
     "SharedString",
+    "SharedSummaryBlock",
     "SharedTree",
+    "TaskManager",
     "default_registry",
 ]

@@ -180,13 +180,27 @@ class ContainerRuntime(EventEmitter):
         if envelope.get("kind") == "attach":
             if not local:
                 self._process_attach(envelope)
+            self._advance_all(msg)
             return
         ds = self.datastores[envelope["address"]]
         ds.process(
             msg, envelope["channel"], envelope["contents"], local,
             local_metadata,
         )
+        self._advance_all(msg)
         self.emit("op", msg, local)
+
+    def observe_system(self, msg: SequencedMessage) -> None:
+        """Window progression from messages that carry no runtime op
+        (joins/leaves/noops): broadcast seq/msn advance to channels."""
+        self._advance_all(msg)
+
+    def _advance_all(self, msg: SequencedMessage) -> None:
+        for ds in self.datastores.values():
+            for channel in ds.channels.values():
+                channel.on_sequence_advance(
+                    msg.sequence_number, msg.minimum_sequence_number
+                )
 
     def _process_attach(self, envelope: dict) -> None:
         """Materialize a remotely-created channel (lazy realization —

@@ -94,6 +94,13 @@ class SharedObject(abc.ABC):
             f"{type(self).__name__} does not support stashed ops yet"
         )
 
+    def on_sequence_advance(self, seq: int, min_seq: int) -> None:
+        """Called for EVERY sequenced message the container processes
+        (not just this channel's ops): collab-window progression. The
+        reference surfaces this via the runtime's deltaManager events;
+        consensus-style DDSes (quorum, register-collection) key their
+        accept logic off msn advancing past their op's seq."""
+
     def signature(self) -> Any:
         """Canonical user-visible content, for convergence checks.
         Replica-local artifacts (tombstone granularity, intern order)

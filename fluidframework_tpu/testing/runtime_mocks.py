@@ -114,6 +114,8 @@ class ContainerSession:
             ep.last_seen_seq = msg.sequence_number
             if msg.type == MessageType.OPERATION:
                 ep.runtime.process(msg)
+            else:
+                ep.runtime.observe_system(msg)
 
     # ------------------------------------------------------------------
     # reconnect
@@ -145,6 +147,8 @@ class ContainerSession:
             ep.last_seen_seq = msg.sequence_number
             if msg.type == MessageType.OPERATION:
                 ep.runtime.process(msg)
+            else:
+                ep.runtime.observe_system(msg)
         ep.missed.clear()
         ep.connected = True
         ep.csn = 0  # the service forgot us on leave; csn restarts at 1

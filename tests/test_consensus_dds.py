@@ -1,0 +1,293 @@
+"""Consensus DDS family: register collection, ordered collection,
+task manager, quorum, ink, summary block.
+
+Mirrors the reference DDS test approach (packages/dds/*/src/test):
+multi-client sessions over the mock sequencer, interleaved ops,
+convergence + semantics asserts.
+"""
+import pytest
+
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+
+def make_session(n, ctype, cid="chan"):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    s = ContainerSession(ids)
+    for c in ids:
+        s.runtime(c).create_datastore("ds").create_channel(ctype, cid)
+    chans = [
+        s.runtime(c).get_datastore("ds").get_channel(cid) for c in ids
+    ]
+    return s, chans
+
+
+# ----------------------------------------------------------------------
+# ConsensusRegisterCollection
+
+def test_register_write_sequences_before_visible():
+    s, (ra, rb) = make_session(2, "consensusregistercollection")
+    ra.write("k", 1)
+    assert ra.read("k") is None  # consensus: nothing until sequenced
+    s.process_all()
+    assert ra.read("k") == 1
+    assert rb.read("k") == 1
+
+
+def test_register_concurrent_writes_keep_versions():
+    s, (ra, rb) = make_session(2, "consensusregistercollection")
+    ra.write("k", "a")
+    rb.write("k", "b")
+    s.process_all()
+    # neither writer had seen the other: both versions survive,
+    # atomic read = earliest sequenced
+    for r in (ra, rb):
+        assert r.read("k") == "a"
+        assert r.read_versions("k") == ["a", "b"]
+    assert ra.signature() == rb.signature()
+
+
+def test_register_sequential_write_supersedes():
+    s, (ra, rb) = make_session(2, "consensusregistercollection")
+    ra.write("k", "a")
+    s.process_all()
+    rb.write("k", "b")  # b's refSeq covers a's write
+    s.process_all()
+    for r in (ra, rb):
+        assert r.read_versions("k") == ["b"]
+
+
+def test_register_completion_callback_reports_winner():
+    s, (ra, rb) = make_session(2, "consensusregistercollection")
+    results = {}
+    ra.write("k", "a", on_complete=lambda won: results.__setitem__("a", won))
+    rb.write("k", "b", on_complete=lambda won: results.__setitem__("b", won))
+    s.process_all()
+    assert results == {"a": True, "b": False}
+
+
+# ----------------------------------------------------------------------
+# ConsensusOrderedCollection
+
+def test_ordered_collection_acquire_complete():
+    s, (ca, cb) = make_session(2, "consensusorderedcollection")
+    ca.add("job1")
+    ca.add("job2")
+    s.process_all()
+    assert ca.size == 2 and cb.size == 2
+    aid = cb.acquire()
+    s.process_all()
+    assert cb.result_of(aid) == "job1"
+    assert ca.size == 1  # leased, no longer queued
+    assert ca.leases() and list(ca.leases().values())[0]["client"] == "B"
+    cb.complete(aid)
+    s.process_all()
+    assert not ca.leases() and not cb.leases()
+    assert ca.signature() == cb.signature()
+
+
+def test_ordered_collection_concurrent_acquire_one_winner():
+    s, (ca, cb) = make_session(2, "consensusorderedcollection")
+    ca.add("only")
+    s.process_all()
+    aid_a = ca.acquire()
+    aid_b = cb.acquire()
+    s.process_all()
+    assert ca.result_of(aid_a) == "only"  # sequenced first
+    assert cb.result_of(aid_b) is None    # queue was empty
+    assert ca.signature() == cb.signature()
+
+
+def test_ordered_collection_release_returns_to_head():
+    s, (ca, cb) = make_session(2, "consensusorderedcollection")
+    ca.add("j1")
+    ca.add("j2")
+    s.process_all()
+    aid = ca.acquire()
+    s.process_all()
+    ca.release(aid)
+    s.process_all()
+    aid2 = cb.acquire()
+    s.process_all()
+    assert cb.result_of(aid2) == "j1"  # released work reclaims its slot
+
+
+# ----------------------------------------------------------------------
+# TaskManager
+
+def test_taskmanager_first_volunteer_wins():
+    s, (ta, tb) = make_session(2, "taskmanager")
+    ta.volunteer("summarizer")
+    tb.volunteer("summarizer")
+    s.process_all()
+    assert ta.have_task("summarizer")
+    assert not tb.have_task("summarizer")
+    assert tb.queued("summarizer")
+    assert ta.signature() == tb.signature()
+
+
+def test_taskmanager_abandon_passes_assignment():
+    s, (ta, tb) = make_session(2, "taskmanager")
+    ta.volunteer("t")
+    tb.volunteer("t")
+    s.process_all()
+    events = []
+    tb.on("assigned", lambda tid, who: events.append((tid, who)))
+    ta.abandon("t")
+    s.process_all()
+    assert tb.have_task("t")
+    assert ("t", "B") in events
+
+
+def test_taskmanager_client_left_reassigns():
+    s, (ta, tb) = make_session(2, "taskmanager")
+    ta.volunteer("t")
+    tb.volunteer("t")
+    s.process_all()
+    tb.client_left("A")
+    assert tb.assigned("t") == "B"
+
+
+def test_ordered_collection_client_left_releases_leases():
+    s, (ca, cb) = make_session(2, "consensusorderedcollection")
+    ca.add("j1")
+    s.process_all()
+    aid = cb.acquire()
+    s.process_all()
+    assert ca.size == 0 and ca.leases()
+    for c in (ca, cb):
+        c.client_left("B")
+    assert ca.size == 1 and not ca.leases()
+    assert ca.signature() == cb.signature()
+
+
+def test_taskmanager_abandon_then_revolunteer():
+    """A pending abandon must not swallow a re-volunteer (the queue
+    still lists us while the abandon is in flight)."""
+    s, (ta, tb) = make_session(2, "taskmanager")
+    ta.volunteer("job")
+    s.process_all()
+    ta.abandon("job")
+    ta.volunteer("job")
+    s.process_all()
+    assert ta.queued("job")
+    assert ta.have_task("job")
+
+
+def test_ink_remote_clear_interleaves_with_pending_ops():
+    """A clear sequencing between a peer's optimistic ops and their
+    acks must still converge: peers apply those ops post-clear."""
+    s, (ia, ib) = make_session(2, "ink")
+    ib.clear()  # sequences first
+    sid = ia.create_stroke({"c": "red"})
+    ia.append_point(sid, {"x": 1})
+    s.flush("B")
+    s.flush("A")
+    s.process_all()
+    assert ia.get_stroke(sid)["points"] == [{"x": 1}]
+    assert ia.signature() == ib.signature()
+
+
+def test_ink_append_to_cleared_stroke_is_noop():
+    s, (ia, ib) = make_session(2, "ink")
+    sid = ia.create_stroke()
+    s.process_all()
+    ib.clear()
+    s.process_all()
+    ia.append_point(sid, {"x": 9})  # stroke gone: silent no-op
+    s.process_all()
+    assert ia.get_stroke(sid) is None
+    assert ia.signature() == ib.signature()
+
+
+def test_quorum_accepts_via_attach_traffic():
+    """Window advances carried by attach ops must reach msn-keyed
+    DDSes (regression: attach early-return skipped _advance_all)."""
+    s, (qa, qb) = make_session(2, "sharedquorum")
+    qa.set("k", "v")
+    s.process_all()
+    assert qa.get("k") is None
+    # only attach traffic from both clients from here on
+    s.runtime("A").get_datastore("ds").create_channel("sharedcell", "c1")
+    s.runtime("B").get_datastore("ds").create_channel("sharedcell", "c2")
+    s.process_all()
+    s.runtime("A").get_datastore("ds").create_channel("sharedcell", "c3")
+    s.runtime("B").get_datastore("ds").create_channel("sharedcell", "c4")
+    s.process_all()
+    for q in (qa, qb):
+        assert q.get("k") == "v"
+
+
+# ----------------------------------------------------------------------
+# SharedQuorum
+
+def test_quorum_accepts_after_all_clients_caught_up():
+    s, (qa, qb) = make_session(2, "sharedquorum")
+    qa.set("k", "v")
+    s.process_all()
+    # sequenced but msn hasn't caught up: still pending
+    assert qa.get("k") is None and qa.get_pending("k") == "v"
+    # traffic from BOTH clients advances everyone's refSeq past the set
+    qa.set("other", 1)
+    qb.set("other2", 2)
+    s.process_all()
+    qa.set("other3", 3)
+    qb.set("other4", 4)
+    s.process_all()
+    for q in (qa, qb):
+        assert q.get("k") == "v", (q.get_pending("k"), q._accepted)
+    assert qa.signature() == qb.signature()
+
+
+def test_quorum_later_set_supersedes_pending():
+    s, (qa, qb) = make_session(2, "sharedquorum")
+    qa.set("k", "first")
+    qb.set("k", "second")
+    s.process_all()
+    for q in (qa, qb):
+        assert q.get_pending("k") == "second"
+
+
+# ----------------------------------------------------------------------
+# Ink
+
+def test_ink_strokes_converge():
+    s, (ia, ib) = make_session(2, "ink")
+    sid = ia.create_stroke({"color": "red"})
+    ia.append_point(sid, {"x": 1, "y": 2})
+    ia.append_point(sid, {"x": 3, "y": 4})
+    s.process_all()
+    stroke = ib.get_stroke(sid)
+    assert stroke["pen"] == {"color": "red"}
+    assert stroke["points"] == [{"x": 1, "y": 2}, {"x": 3, "y": 4}]
+    assert ia.signature() == ib.signature()
+
+
+def test_ink_clear_drops_concurrent_appends():
+    s, (ia, ib) = make_session(2, "ink")
+    sid = ia.create_stroke()
+    s.process_all()
+    ib.clear()
+    ia.append_point(sid, {"x": 9, "y": 9})  # concurrent with clear
+    s.process_all()
+    # clear sequenced first; the append to a dropped stroke is a no-op
+    assert ia.get_strokes() == [] or ia.get_stroke(sid) is None
+    assert ia.signature() == ib.signature()
+
+
+# ----------------------------------------------------------------------
+# SharedSummaryBlock
+
+def test_summary_block_roundtrip():
+    from fluidframework_tpu.models.summaryblock import SharedSummaryBlock
+    blk = SharedSummaryBlock("b")
+    blk.set("schema", {"v": 1})
+    summary = blk.summarize_core()
+    fresh = SharedSummaryBlock("b")
+    fresh.load_core(summary)
+    assert fresh.get("schema") == {"v": 1}
+
+
+def test_summary_block_rejects_live_writes():
+    s, (ba,) = make_session(1, "sharedsummaryblock")
+    with pytest.raises(RuntimeError):
+        ba.set("k", 1)
