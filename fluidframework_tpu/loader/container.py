@@ -29,6 +29,7 @@ from ..protocol.messages import (
 from ..protocol.quorum import ProtocolOpHandler
 from ..runtime import ChannelRegistry, ContainerRuntime
 from ..utils.events import EventEmitter
+from .scheduler import DeltaScheduler, ScheduleManager
 
 
 class Container(EventEmitter):
@@ -45,6 +46,11 @@ class Container(EventEmitter):
         self._connection = None
         self._csn = 0
         self.closed = False
+        # inbound scheduling: batch integrity + sliced draining
+        self._schedule = ScheduleManager()
+        self._scheduler = DeltaScheduler(self._process)
+        self.inbound_paused = False
+        self._enqueued_seq = 0
 
     # ------------------------------------------------------------------
     # load (container.ts load path, §3.3)
@@ -99,6 +105,9 @@ class Container(EventEmitter):
         assert not self.closed
         if self.connected:
             return
+        # stale queued messages would double-process after the direct
+        # catch-up below; they are all in the op log and get refetched
+        self._clear_inbound_state()
         # catch up anything missed while disconnected, THEN attach the
         # live stream (CatchingUp -> Connected, connectionStateHandler)
         for msg in self.service.read_ops(self.last_processed_seq):
@@ -114,8 +123,14 @@ class Container(EventEmitter):
         if self._connection is not None:
             self._connection.disconnect()
             self._connection = None
+        self._clear_inbound_state()
         self.runtime.set_connection_state(False)
         self.emit("disconnected")
+
+    def _clear_inbound_state(self) -> None:
+        self._scheduler.clear()
+        self._schedule.reset()
+        self._enqueued_seq = 0
 
     def close(self) -> None:
         self.disconnect()
@@ -125,16 +140,42 @@ class Container(EventEmitter):
     # inbound (DeltaManager inbound queue + gap refetch)
 
     def _on_message(self, msg: SequencedMessage) -> None:
-        if msg.sequence_number <= self.last_processed_seq:
+        if msg.sequence_number <= self._last_enqueued_seq():
             return  # duplicate delivery
-        if msg.sequence_number > self.last_processed_seq + 1:
+        if msg.sequence_number > self._last_enqueued_seq() + 1:
             # gap: fetch the missing range from delta storage
             # (deltaManager.ts:883 fetchMissingDeltas)
             for missing in self.service.read_ops(
-                self.last_processed_seq, msg.sequence_number - 1
+                self._last_enqueued_seq(), msg.sequence_number - 1
             ):
-                self._process(missing)
-        self._process(msg)
+                self._enqueue_inbound(missing)
+        self._enqueue_inbound(msg)
+        if not self.inbound_paused:
+            self._scheduler.drain()
+
+    def _last_enqueued_seq(self) -> int:
+        return max(self.last_processed_seq, self._enqueued_seq)
+
+    def _enqueue_inbound(self, msg: SequencedMessage) -> None:
+        self._enqueued_seq = msg.sequence_number
+        self._scheduler.enqueue(self._schedule.feed(msg))
+
+    # DeltaQueue pause/resume (deltaQueue.ts:15) + sliced drain
+    def pause_inbound(self) -> None:
+        self.inbound_paused = True
+
+    def resume_inbound(self) -> None:
+        self.inbound_paused = False
+        self._scheduler.drain()
+
+    def process_inbound(self, slice_s: Optional[float] = None) -> int:
+        """Explicit host-driven drain of queued inbound units,
+        optionally time-budgeted (DeltaScheduler 50ms slices). This is
+        the manual companion to ``pause_inbound`` — pausing stops the
+        automatic drain; this call processes on the host's schedule
+        (pass ``DeltaScheduler.DEFAULT_SLICE_S`` for the reference's
+        50ms slice). Returns messages processed."""
+        return self._scheduler.drain(slice_s)
 
     def _process(self, msg: SequencedMessage) -> None:
         assert msg.sequence_number == self.last_processed_seq + 1, (
@@ -171,6 +212,7 @@ class Container(EventEmitter):
             reference_sequence_number=self.last_processed_seq,
             type=MessageType.OPERATION,
             contents=contents,
+            metadata=metadata,
         ))
 
     def flush(self) -> None:

@@ -28,6 +28,9 @@ _OP_CLASSES = {
 
 
 def encode_contents(value: Any) -> Any:
+    from ..models.intervals import IntervalOp
+    if isinstance(value, IntervalOp):
+        return {"__intervalop__": dataclasses.asdict(value)}
     if isinstance(value, (InsertOp, RemoveOp, AnnotateOp)):
         d = dataclasses.asdict(value)
         d["type"] = int(value.type)
@@ -50,6 +53,9 @@ def encode_contents(value: Any) -> Any:
 
 def decode_contents(value: Any) -> Any:
     if isinstance(value, dict):
+        if "__intervalop__" in value:
+            from ..models.intervals import IntervalOp
+            return IntervalOp(**value["__intervalop__"])
         if "__mergeop__" in value:
             d = dict(value["__mergeop__"])
             kind = DeltaType(d.pop("type"))
@@ -75,6 +81,7 @@ def message_to_json(msg: SequencedMessage) -> dict:
         "referenceSequenceNumber": msg.reference_sequence_number,
         "type": int(msg.type),
         "contents": encode_contents(msg.contents),
+        "metadata": encode_contents(msg.metadata),
         "timestamp": msg.timestamp,
     }
 
@@ -88,6 +95,7 @@ def message_from_json(data: dict) -> SequencedMessage:
         reference_sequence_number=data["referenceSequenceNumber"],
         type=MessageType(data["type"]),
         contents=decode_contents(data["contents"]),
+        metadata=decode_contents(data.get("metadata")),
         timestamp=data.get("timestamp", 0.0),
     )
 

@@ -17,6 +17,13 @@ from typing import Any, Callable, Optional
 from ..protocol.messages import SequencedMessage
 from ..utils.events import EventEmitter
 from .datastore import DataStoreRuntime
+from .op_lifecycle import (
+    OpCompressor,
+    OpSplitter,
+    RemoteMessageProcessor,
+    mark_batch,
+    stage_outbound,
+)
 from .shared_object import ChannelRegistry
 
 
@@ -79,6 +86,11 @@ class ContainerRuntime(EventEmitter):
         self.client_id: str = ""
         self.connected = False
         self.reconnect_epoch = 0  # bumped on every reconnect
+        # op lifecycle stages (opLifecycle/): outbound compress+chunk,
+        # inbound reassemble+decompress
+        self.compressor = OpCompressor()
+        self.splitter = OpSplitter()
+        self._inbound = RemoteMessageProcessor()
 
     # ------------------------------------------------------------------
     # wiring
@@ -143,21 +155,28 @@ class ContainerRuntime(EventEmitter):
         a submit can deliver (and re-enter flush) before this call
         returns, and the op must not be sent twice."""
         ops, self._outbox = self._outbox, []
-        sent = 0
+        # Stage every wire message first (compress -> chunk), so batch
+        # boundary marks land on the true first/last wire message.
+        staged: list[tuple[dict, Any]] = []
         for op in ops:
             self.pending.on_submit(op)
-            if self._submit_fn is not None:
-                self._submit_fn(
-                    {
-                        "kind": op.kind,
-                        "address": op.datastore_id,
-                        "channel": op.channel_id,
-                        "contents": op.contents,
-                    },
-                    op.metadata,
-                )
-            sent += 1
-        return sent
+            envelope = {
+                "kind": op.kind,
+                "address": op.datastore_id,
+                "channel": op.channel_id,
+                "contents": op.contents,
+            }
+            for wire in stage_outbound(
+                envelope, self.compressor, self.splitter
+            ):
+                staged.append((wire, op.metadata))
+        if len(staged) > 1:
+            staged[0] = (staged[0][0], mark_batch(staged[0][1], True))
+            staged[-1] = (staged[-1][0], mark_batch(staged[-1][1], False))
+        if self._submit_fn is not None:
+            for wire, metadata in staged:
+                self._submit_fn(wire, metadata)
+        return len(ops)
 
     def order_sequentially(self, callback: Callable[[], None]) -> None:
         """containerRuntime.ts:1860: run ``callback``, then flush its
@@ -169,7 +188,13 @@ class ContainerRuntime(EventEmitter):
     # inbound (process :1701)
 
     def process(self, msg: SequencedMessage) -> None:
-        envelope = msg.contents
+        # Inbound lifecycle: chunks buffer until complete, compressed
+        # envelopes inflate (remoteMessageProcessor.ts:11). A chunked
+        # op takes effect — and acks — at its FINAL chunk's seq.
+        envelope = self._inbound.process(msg.client_id, msg.contents)
+        if envelope is None:
+            self._advance_all(msg)  # mid-chunk: window still advances
+            return
         # Own ops are acks even when they arrive during catch-up while
         # reconnecting (the connection flag is down but the op is ours).
         local = bool(self.client_id) and msg.client_id == self.client_id

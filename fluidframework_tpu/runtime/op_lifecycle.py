@@ -1,0 +1,171 @@
+"""Outbound/inbound op lifecycle: batching marks, compression, chunking.
+
+Reference: packages/runtime/container-runtime/src/opLifecycle/ —
+``Outbox`` (outbox.ts:35), ``BatchManager`` (batchManager.ts:22),
+``OpCompressor`` (opCompressor.ts:18, lz4 there; zlib here — same
+boundary, different codec), ``OpSplitter`` (opSplitter.ts:18, chunked
+ops for >1MB messages), ``OpDecompressor`` (:20) and
+``RemoteMessageProcessor`` (remoteMessageProcessor.ts:11) as the
+inbound inverse.
+
+Stages compose outbound as: envelope -> compress (if large) -> split
+(if still large); inbound: reassemble chunks -> decompress -> decode.
+The wire form is the JSON encoding from ``protocol.serialization`` so
+payload sizes are measured on real serialized bytes.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import uuid
+import zlib
+from typing import Any, Optional
+
+
+def _encode(envelope: dict) -> str:
+    from ..protocol.serialization import encode_contents
+    return json.dumps(encode_contents(envelope))
+
+
+def _decode(payload: str) -> dict:
+    from ..protocol.serialization import decode_contents
+    return decode_contents(json.loads(payload))
+
+
+class OpCompressor:
+    """Compress large op envelopes (opCompressor.ts:18)."""
+
+    def __init__(self, min_size: int = 4 * 1024):
+        self.min_size = min_size
+
+    def maybe_compress(self, envelope: dict) -> dict:
+        try:
+            payload = _encode(envelope)
+        except TypeError:
+            return envelope  # not wire-encodable: leave in-proc form
+        return self.compress_encoded(envelope, payload)[0]
+
+    def compress_encoded(self, envelope: dict, payload: str
+                         ) -> tuple[dict, str]:
+        """Same, reusing an already-encoded payload; returns the
+        (possibly new) envelope and its encoding."""
+        if len(payload) < self.min_size:
+            return envelope, payload
+        data = base64.b64encode(
+            zlib.compress(payload.encode("utf-8"))
+        ).decode("ascii")
+        if len(data) >= len(payload):
+            return envelope, payload  # incompressible; keep plain
+        compressed = {"kind": "compressed", "data": data}
+        return compressed, _encode(compressed)
+
+
+class OpDecompressor:
+    """Inbound inverse (opDecompressor.ts:20)."""
+
+    @staticmethod
+    def decompress(envelope: dict) -> dict:
+        if envelope.get("kind") != "compressed":
+            return envelope
+        payload = zlib.decompress(
+            base64.b64decode(envelope["data"])
+        ).decode("utf-8")
+        return _decode(payload)
+
+
+class OpSplitter:
+    """Split oversized envelopes into chunked ops (opSplitter.ts:18).
+    Each chunk rides its own message; the op takes effect at the final
+    chunk's sequence number."""
+
+    def __init__(self, chunk_size: int = 768 * 1024):
+        self.chunk_size = chunk_size
+
+    def split(self, envelope: dict) -> list[dict]:
+        try:
+            payload = _encode(envelope)
+        except TypeError:
+            return [envelope]  # not wire-encodable: leave in-proc form
+        return self.split_encoded(envelope, payload)
+
+    def split_encoded(self, envelope: dict, payload: str) -> list[dict]:
+        if len(payload) <= self.chunk_size:
+            return [envelope]
+        chunk_id = uuid.uuid4().hex
+        pieces = [
+            payload[i:i + self.chunk_size]
+            for i in range(0, len(payload), self.chunk_size)
+        ]
+        return [
+            {
+                "kind": "chunk",
+                "chunkId": chunk_id,
+                "index": i,
+                "total": len(pieces),
+                "data": piece,
+            }
+            for i, piece in enumerate(pieces)
+        ]
+
+
+class ChunkReassembler:
+    """Collects chunk pieces per (client, chunkId); returns the
+    original envelope when the final piece arrives."""
+
+    def __init__(self) -> None:
+        self._buffers: dict[tuple, list[Optional[str]]] = {}
+
+    def add(self, client_id: str, envelope: dict) -> Optional[dict]:
+        key = (client_id, envelope["chunkId"])
+        buf = self._buffers.setdefault(key, [None] * envelope["total"])
+        buf[envelope["index"]] = envelope["data"]
+        if any(piece is None for piece in buf):
+            return None
+        del self._buffers[key]
+        return _decode("".join(buf))
+
+
+class RemoteMessageProcessor:
+    """Inbound pipeline (remoteMessageProcessor.ts:11): reassemble,
+    then decompress. Returns the logical envelope, or None while a
+    chunked op is still incomplete."""
+
+    def __init__(self) -> None:
+        self._reassembler = ChunkReassembler()
+        self._decompressor = OpDecompressor()
+
+    def process(self, client_id: str, envelope: Any) -> Optional[dict]:
+        if isinstance(envelope, dict) and envelope.get("kind") == "chunk":
+            envelope = self._reassembler.add(client_id, envelope)
+            if envelope is None:
+                return None
+        if isinstance(envelope, dict):
+            envelope = self._decompressor.decompress(envelope)
+        return envelope
+
+
+def stage_outbound(envelope: dict, compressor: OpCompressor,
+                   splitter: OpSplitter) -> list[dict]:
+    """Outbound staging with a single wire encoding shared by both
+    stages: encode once -> compress if beneficial -> chunk if large."""
+    try:
+        payload = _encode(envelope)
+    except TypeError:
+        return [envelope]  # in-proc-only payload: send as-is
+    envelope, payload = compressor.compress_encoded(envelope, payload)
+    return splitter.split_encoded(envelope, payload)
+
+
+def mark_batch(metadata: Any, flag: bool) -> dict:
+    """Batch boundary marks riding message metadata
+    (batchManager.ts batch metadata: first op {batch: true}, last
+    {batch: false}; singletons carry no mark)."""
+    out = dict(metadata) if isinstance(metadata, dict) else {}
+    out["batch"] = flag
+    return out
+
+
+def batch_flag(metadata: Any) -> Optional[bool]:
+    if isinstance(metadata, dict):
+        return metadata.get("batch")
+    return None

@@ -43,7 +43,7 @@ class ContainerSession:
             runtime = ContainerRuntime(registry or default_registry())
             runtime.set_submit_fn(
                 lambda contents, metadata, cid=cid:
-                self._enqueue(cid, contents)
+                self._enqueue(cid, contents, metadata)
             )
             runtime.set_connection_state(True, cid)
             self.endpoints[cid] = _Endpoint(runtime=runtime)
@@ -54,7 +54,8 @@ class ContainerSession:
     def runtime(self, client_id: str) -> ContainerRuntime:
         return self.endpoints[client_id].runtime
 
-    def _enqueue(self, client_id: str, contents: Any) -> None:
+    def _enqueue(self, client_id: str, contents: Any,
+                 metadata: Any = None) -> None:
         ep = self.endpoints[client_id]
         if not ep.connected:
             return  # offline; pending state replays on reconnect
@@ -64,6 +65,7 @@ class ContainerSession:
             reference_sequence_number=ep.last_seen_seq,
             type=MessageType.OPERATION,
             contents=contents,
+            metadata=metadata,
         )))
 
     # ------------------------------------------------------------------

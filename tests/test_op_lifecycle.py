@@ -1,0 +1,197 @@
+"""Op lifecycle: compression, chunking, batch marks, scheduling.
+
+Mirrors test-end-to-end-tests/src/test/messageSize.spec.ts (chunked
+>1MB ops), opCompressor/opSplitter unit tests, and ScheduleManager
+batch-integrity tests.
+"""
+import pytest
+
+from fluidframework_tpu.protocol.messages import (
+    MessageType,
+    SequencedMessage,
+)
+from fluidframework_tpu.runtime.op_lifecycle import (
+    ChunkReassembler,
+    OpCompressor,
+    OpDecompressor,
+    OpSplitter,
+    RemoteMessageProcessor,
+    batch_flag,
+    mark_batch,
+)
+from fluidframework_tpu.loader.scheduler import (
+    DeltaScheduler,
+    ScheduleManager,
+)
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+
+# ----------------------------------------------------------------------
+# unit: compressor / splitter / reassembler
+
+def envelope(payload):
+    return {"kind": "op", "address": "ds", "channel": "ch",
+            "contents": payload}
+
+
+def test_compressor_small_ops_pass_through():
+    env = envelope({"v": 1})
+    assert OpCompressor().maybe_compress(env) is env
+
+
+def test_compressor_roundtrip():
+    env = envelope({"text": "na" * 8000})
+    comp = OpCompressor(min_size=128).maybe_compress(env)
+    assert comp["kind"] == "compressed"
+    assert len(str(comp)) < len(str(env))  # actually smaller
+    assert OpDecompressor.decompress(comp) == env
+
+
+def test_splitter_chunks_and_reassembles():
+    env = envelope({"blob": "x" * 1000})
+    chunks = OpSplitter(chunk_size=256).split(env)
+    assert len(chunks) > 1
+    assert all(c["kind"] == "chunk" for c in chunks)
+    ra = ChunkReassembler()
+    done = None
+    for c in chunks:
+        assert done is None
+        done = ra.add("client", c)
+    assert done == env
+
+
+def test_remote_processor_interleaved_clients():
+    """Chunk streams from different clients must not mix."""
+    env_a = envelope({"blob": "a" * 600})
+    env_b = envelope({"blob": "b" * 600})
+    ca = OpSplitter(chunk_size=256).split(env_a)
+    cb = OpSplitter(chunk_size=256).split(env_b)
+    proc = RemoteMessageProcessor()
+    results = []
+    for pair in zip(ca, cb):
+        results.append(proc.process("A", pair[0]))
+        results.append(proc.process("B", pair[1]))
+    finished = [r for r in results if r is not None]
+    assert finished == [env_a, env_b]
+
+
+def test_compress_then_chunk_roundtrip():
+    env = envelope({"blob": "qz" * 4000})
+    comp = OpCompressor(min_size=64).maybe_compress(env)
+    chunks = OpSplitter(chunk_size=128).split(comp)
+    proc = RemoteMessageProcessor()
+    out = None
+    for c in chunks:
+        out = proc.process("A", c)
+    assert out == env
+
+
+# ----------------------------------------------------------------------
+# integration: huge op end-to-end through the runtime stack
+
+def make_session(n=2, ctype="sharedmap", cid="m"):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    s = ContainerSession(ids)
+    for c in ids:
+        s.runtime(c).create_datastore("ds").create_channel(ctype, cid)
+    chans = [
+        s.runtime(c).get_datastore("ds").get_channel(cid) for c in ids
+    ]
+    return s, chans
+
+
+def test_megabyte_op_roundtrips_chunked():
+    """messageSize.spec.ts: >chunk-threshold ops split and converge."""
+    s, (ma, mb) = make_session()
+    for rt in (s.runtime("A"), s.runtime("B")):
+        rt.splitter.chunk_size = 2048  # force chunking at small size
+    big = "payload-" * 4096  # ~32KB
+    ma.set("big", big)
+    sent_before = s.pending_count
+    s.flush("A")
+    assert s.pending_count > 1  # really chunked into several messages
+    s.process_all()
+    assert mb.get("big") == big
+    assert ma.signature() == mb.signature()
+
+
+def test_chunked_own_op_acks_once():
+    s, (ma, mb) = make_session()
+    s.runtime("A").splitter.chunk_size = 1024
+    ma.set("k", "v" * 5000)
+    ma.set("k2", "small")
+    s.process_all()
+    assert s.runtime("A").pending.count == 0
+    assert mb.get("k2") == "small"
+    assert ma.signature() == mb.signature()
+
+
+def test_compressed_op_roundtrips():
+    s, (ma, mb) = make_session()
+    s.runtime("A").compressor.min_size = 64
+    ma.set("k", "abcabc" * 400)
+    s.process_all()
+    assert mb.get("k") == "abcabc" * 400
+
+
+# ----------------------------------------------------------------------
+# batch marks + schedule manager
+
+def seqmsg(n, client="A", metadata=None, mtype=MessageType.OPERATION):
+    return SequencedMessage(
+        client_id=client, sequence_number=n, minimum_sequence_number=0,
+        client_sequence_number=n, reference_sequence_number=0,
+        type=mtype, contents={"n": n}, metadata=metadata,
+    )
+
+
+def test_flush_marks_batch_boundaries():
+    s, (ma, mb) = make_session()
+    ma.set("a", 1)
+    ma.set("b", 2)
+    ma.set("c", 3)
+    s.flush("A")
+    metas = [raw.metadata for _, raw in s._raw_queue]
+    assert batch_flag(metas[0]) is True
+    assert batch_flag(metas[-1]) is False
+    assert all(batch_flag(m) is None for m in metas[1:-1])
+    s.process_all()
+    assert ma.signature() == mb.signature()
+
+
+def test_schedule_manager_releases_complete_batch():
+    sm = ScheduleManager()
+    assert sm.feed(seqmsg(1)) == [seqmsg(1)]
+    assert sm.feed(seqmsg(2, metadata=mark_batch(None, True))) == []
+    assert sm.feed(seqmsg(3)) == []
+    out = sm.feed(seqmsg(4, metadata=mark_batch(None, False)))
+    assert [m.sequence_number for m in out] == [2, 3, 4]
+
+
+def test_schedule_manager_lets_system_messages_through_mid_batch():
+    sm = ScheduleManager()
+    sm.feed(seqmsg(1, metadata=mark_batch(None, True)))
+    join = seqmsg(2, client=None, mtype=MessageType.CLIENT_JOIN)
+    assert sm.feed(join) == [join]
+    out = sm.feed(seqmsg(3, metadata=mark_batch(None, False)))
+    assert [m.sequence_number for m in out] == [1, 3]
+
+
+def test_schedule_manager_asserts_foreign_op_mid_batch():
+    sm = ScheduleManager()
+    sm.feed(seqmsg(1, metadata=mark_batch(None, True)))
+    with pytest.raises(AssertionError):
+        sm.feed(seqmsg(2, client="B"))
+
+
+def test_delta_scheduler_batch_is_atomic_across_slices():
+    processed = []
+    ds = DeltaScheduler(lambda m: processed.append(m.sequence_number))
+    ds.enqueue([seqmsg(1), seqmsg(2)])  # one batch
+    ds.enqueue([seqmsg(3)])
+    # zero budget: first unit still processes whole, then yields
+    ds.drain(slice_s=0.0)
+    assert processed == [1, 2]
+    assert ds.pending_units == 1
+    ds.drain()
+    assert processed == [1, 2, 3]
