@@ -11,13 +11,25 @@ from .shared_object import (
     SharedObject,
     simple_factory,
 )
+from .summarizer import (
+    OrderedClientElection,
+    RunningSummarizer,
+    SummarizerHeuristics,
+    SummaryCollection,
+    SummaryManager,
+)
 
 __all__ = [
     "ChannelFactory",
     "ChannelRegistry",
     "ContainerRuntime",
     "DataStoreRuntime",
+    "OrderedClientElection",
     "PendingStateManager",
+    "RunningSummarizer",
     "SharedObject",
+    "SummarizerHeuristics",
+    "SummaryCollection",
+    "SummaryManager",
     "simple_factory",
 ]

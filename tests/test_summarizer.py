@@ -1,0 +1,171 @@
+"""Summarizer subsystem: election, heuristics, ack flow, failover.
+
+Mirrors container-runtime summarizer tests (summaryManager,
+orderedClientElection, runningSummarizer w/ heuristics) over the
+in-proc service.
+"""
+import pytest
+
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.runtime import (
+    OrderedClientElection,
+    SummarizerHeuristics,
+    SummaryManager,
+)
+from fluidframework_tpu.service.local_server import LocalServer
+
+
+def heuristics():
+    return SummarizerHeuristics(max_ops=5)
+
+
+def make(n=2, doc="doc"):
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    names = ["alice", "bob", "carol"][:n]
+    containers = [
+        Container.load(factory.create_document_service(doc), client_id=c)
+        for c in names
+    ]
+    managers = [
+        SummaryManager(c, heuristics_factory=heuristics)
+        for c in containers
+    ]
+    return server, factory, containers, managers
+
+
+# ----------------------------------------------------------------------
+# election
+
+def test_election_oldest_eligible_client():
+    e = OrderedClientElection()
+    e.add_client("read-1", eligible=False)
+    e.add_client("w-1")
+    e.add_client("w-2")
+    assert e.elected == "w-1"
+    e.remove_client("w-1")
+    assert e.elected == "w-2"
+
+
+def test_first_joined_container_becomes_summarizer():
+    server, factory, (a, b), (ma, mb) = make(2)
+    assert ma.is_summarizer
+    assert not mb.is_summarizer
+
+
+def test_summary_produced_after_op_threshold_and_acked():
+    server, factory, (a, b), (ma, mb) = make(2)
+    ds = a.runtime.create_datastore("d")
+    m = ds.create_channel("sharedmap", "kv")
+    a.flush()
+    acked = []
+    ma.collection.on("summaryAck", lambda ack: acked.append(ack))
+    for i in range(8):
+        m.set(f"k{i}", i)
+        a.flush()
+    assert acked, "no summary ack observed"
+    assert ma.running.summaries_produced >= 1
+    # ack observed by the non-summarizer too
+    assert mb.collection.last_ack_seq > 0
+    # service summary actually stored
+    assert server.get_orderer("doc").summary_store.latest() is not None
+
+
+def test_new_client_loads_from_produced_summary():
+    server, factory, (a, b), (ma, mb) = make(2)
+    ds = a.runtime.create_datastore("d")
+    m = ds.create_channel("sharedmap", "kv")
+    a.flush()
+    for i in range(8):
+        m.set(f"k{i}", i)
+        a.flush()
+    assert ma.collection.last_ack_seq > 0
+    late = Container.load(factory.create_document_service("doc"),
+                          client_id="dora")
+    kv = late.runtime.get_datastore("d").get_channel("kv")
+    assert kv.get("k7") == 7
+
+
+def test_summarizer_failover_on_leave():
+    server, factory, (a, b), (ma, mb) = make(2)
+    ds = a.runtime.create_datastore("d")
+    m = ds.create_channel("sharedmap", "kv")
+    a.flush()
+    assert ma.is_summarizer and not mb.is_summarizer
+    a.disconnect()
+    # bob observes alice's leave and takes over
+    assert mb.is_summarizer
+    mb_chan = b.runtime.get_datastore("d").get_channel("kv")
+    acked = []
+    mb.collection.on("summaryAck", lambda ack: acked.append(ack))
+    for i in range(8):
+        mb_chan.set(f"x{i}", i)
+        b.flush()
+    assert acked, "failover summarizer produced no ack"
+
+
+def test_summarizer_defers_while_dirty_then_fires_on_tick():
+    """The dirty guard blocks an attempt; a later tick (once
+    quiescent) produces the deferred summary."""
+    server, factory, (a, b), (ma, mb) = make(2)
+    ds = a.runtime.create_datastore("d")
+    m = ds.create_channel("sharedmap", "kv")
+    a.flush()
+    run = ma.running
+    run.heuristics.ops_since_summary = 99  # over threshold
+    m.set("unflushed", 1)  # outbox non-empty -> dirty
+    assert a.runtime.is_dirty
+    run.maybe_summarize()
+    assert not run.attempt_pending  # deferred, not attempted
+    produced = run.summaries_produced
+    a.flush()  # quiescent again (sync service acks immediately)
+    run.heuristics.ops_since_summary = 99
+    ma.tick()
+    assert run.summaries_produced > produced
+
+
+def test_time_heuristic_fires_via_tick_on_quiet_document():
+    clock = [0.0]
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    a = Container.load(factory.create_document_service("doc"),
+                       client_id="alice")
+    ma = SummaryManager(a, heuristics_factory=lambda: SummarizerHeuristics(
+        max_ops=1000, max_time_s=60, clock=lambda: clock[0]))
+    m = a.runtime.create_datastore("d").create_channel("sharedmap", "kv")
+    a.flush()
+    m.set("k", 1)
+    a.flush()
+    assert ma.running.summaries_produced == 0
+    clock[0] = 120.0  # a minute passes with zero traffic
+    ma.tick()
+    assert ma.running.summaries_produced == 1
+
+
+def test_foreign_summary_ack_not_claimed_by_summarizer():
+    """Another client's direct summarize() must not be attributed to
+    the elected summarizer's attempt."""
+    server, factory, (a, b), (ma, mb) = make(2)
+    m = a.runtime.create_datastore("d").create_channel("sharedmap", "kv")
+    a.flush()
+    m.set("k", 1)
+    a.flush()
+    produced = ma.running.summaries_produced
+    b.summarize()  # bob summarizes out-of-band
+    assert ma.running.summaries_produced == produced
+    # but the freshness reset applies: the heuristic saw a summary
+    assert ma.running.heuristics.ops_since_summary == 0
+
+
+def test_summary_manager_dispose_detaches():
+    server, factory, (a, b), (ma, mb) = make(2)
+    m = a.runtime.create_datastore("d").create_channel("sharedmap", "kv")
+    a.flush()
+    ma.dispose()
+    assert ma.disposed and not ma.is_summarizer
+    for i in range(10):
+        m.set(f"k{i}", i)
+        a.flush()
+    # no summaries: the disposed manager stopped observing
+    assert server.get_orderer("doc").summary_store.latest() is None
