@@ -10,7 +10,12 @@ from pathlib import Path
 from typing import Optional
 
 from ..protocol.messages import SequencedMessage
-from ..protocol.serialization import message_from_json, message_to_json
+from ..protocol.serialization import (
+    decode_contents,
+    encode_contents,
+    message_from_json,
+    message_to_json,
+)
 from .replay_driver import ReplayDocumentService
 
 
@@ -20,8 +25,10 @@ def save_document(path: str | Path, document_id: str,
     blob = {
         "documentId": document_id,
         "messages": [message_to_json(m) for m in messages],
+        # summaries can hold FluidHandles and op dataclasses: encode
         "summary": (
-            {"sequenceNumber": summary[0], "tree": summary[1]}
+            {"sequenceNumber": summary[0],
+             "tree": encode_contents(summary[1])}
             if summary else None
         ),
     }
@@ -33,7 +40,7 @@ def load_document(path: str | Path) -> ReplayDocumentService:
     summary = None
     if blob.get("summary"):
         summary = (blob["summary"]["sequenceNumber"],
-                   blob["summary"]["tree"])
+                   decode_contents(blob["summary"]["tree"]))
     return ReplayDocumentService(
         document_id=blob["documentId"],
         messages=[message_from_json(d) for d in blob["messages"]],

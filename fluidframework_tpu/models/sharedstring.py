@@ -78,6 +78,15 @@ class SharedString(SharedObject, EventEmitter):
             self._interval_collections[label] = coll
         return coll
 
+    def attribution_at(self, pos: int) -> Optional[int]:
+        """Attribution key (insert seq) for the character at ``pos`` —
+        feed to an ``Attributor`` for (user, timestamp)
+        (attributionCollection.ts keys == segment seqs). ``None`` for
+        locally-inserted text whose op has not sequenced yet (no
+        authorship record exists anywhere until the ack)."""
+        seg, _ = self.client.mergetree.segment_at(pos)
+        return None if seg.seq == UNASSIGNED_SEQ else seg.seq
+
     def create_position_reference(self, pos: int, ref_type: int):
         """Public cursor-anchor API (sharedString createLocalReference
         passthrough)."""

@@ -29,6 +29,9 @@ _OP_CLASSES = {
 
 def encode_contents(value: Any) -> Any:
     from ..models.intervals import IntervalOp
+    from ..runtime.handles import FluidHandle
+    if isinstance(value, FluidHandle):
+        return {"__handle__": value.route}
     if isinstance(value, IntervalOp):
         return {"__intervalop__": dataclasses.asdict(value)}
     if isinstance(value, (InsertOp, RemoveOp, AnnotateOp)):
@@ -53,6 +56,9 @@ def encode_contents(value: Any) -> Any:
 
 def decode_contents(value: Any) -> Any:
     if isinstance(value, dict):
+        if "__handle__" in value:
+            from ..runtime.handles import FluidHandle
+            return FluidHandle(value["__handle__"])
         if "__intervalop__" in value:
             from ..models.intervals import IntervalOp
             return IntervalOp(**value["__intervalop__"])

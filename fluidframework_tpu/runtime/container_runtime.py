@@ -91,6 +91,15 @@ class ContainerRuntime(EventEmitter):
         self.compressor = OpCompressor()
         self.splitter = OpSplitter()
         self._inbound = RemoteMessageProcessor()
+        # blobs + GC (blobManager.ts:118, garbageCollection.ts:340)
+        from .blobs import BlobManager
+        self.blobs = BlobManager(self)
+        self.tombstones: set[str] = set()
+        # GC state: set by an attached GarbageCollector, or loaded
+        # from a summary produced by the (summarizer's) collector —
+        # this is how GC results reach every replica (§3.4)
+        self.gc: Any = None
+        self._loaded_gc_state: Optional[dict] = None
 
     # ------------------------------------------------------------------
     # wiring
@@ -119,14 +128,24 @@ class ContainerRuntime(EventEmitter):
     # ------------------------------------------------------------------
     # datastores
 
-    def create_datastore(self, datastore_id: str) -> DataStoreRuntime:
+    def create_datastore(self, datastore_id: str,
+                         root: bool = True) -> DataStoreRuntime:
+        """``root=True`` (aliased in the reference) makes the store a
+        GC root; non-root stores stay alive only while a handle to
+        them (or a channel of theirs) is stored somewhere reachable."""
         if datastore_id in self.datastores:
             raise ValueError(f"datastore {datastore_id!r} exists")
-        ds = DataStoreRuntime(self, datastore_id, self.registry)
+        ds = DataStoreRuntime(self, datastore_id, self.registry, root=root)
         self.datastores[datastore_id] = ds
         return ds
 
     def get_datastore(self, datastore_id: str) -> DataStoreRuntime:
+        route = f"/{datastore_id}"
+        if route in self.tombstones:
+            raise KeyError(
+                f"datastore {datastore_id!r} is tombstoned (GC): "
+                "it has been unreferenced past the tombstone timeout"
+            )
         return self.datastores[datastore_id]
 
     # ------------------------------------------------------------------
@@ -142,9 +161,11 @@ class ContainerRuntime(EventEmitter):
         """Announce a locally-created channel so remote containers can
         materialize it (the Attach op: a new channel's type + initial
         snapshot travel in the op stream)."""
+        ds = self.datastores[datastore_id]
         self._outbox.append(PendingOp(
             datastore_id, channel_id,
-            {"channelType": channel_type, "summary": summary},
+            {"channelType": channel_type, "summary": summary,
+             "root": ds.root},
             None, kind="attach",
         ))
 
@@ -184,6 +205,52 @@ class ContainerRuntime(EventEmitter):
         callback()
         self.flush()
 
+    def submit_blob_attach(self, blob_id: str, data_b64: str) -> None:
+        """BlobAttach op (ContainerMessageType.BlobAttach)."""
+        self._outbox.append(PendingOp(
+            "", "", {"id": blob_id, "data": data_b64}, None,
+            kind="blobAttach",
+        ))
+
+    # ------------------------------------------------------------------
+    # GC surface (garbageCollection.ts:340 consumes this)
+
+    def get_gc_graph(self) -> tuple[dict[str, list[str]], list[str]]:
+        """(node -> outbound routes, roots). Nodes: datastores,
+        channels, blobs. A channel references its parent store (child
+        keeps parent alive, as in the reference's node hierarchy)."""
+        graph: dict[str, list[str]] = {}
+        roots: list[str] = []
+        for ds_id, ds in self.datastores.items():
+            ds_route = f"/{ds_id}"
+            graph[ds_route] = [
+                f"{ds_route}/{cid}" for cid in ds.channels
+            ]
+            if ds.root:
+                roots.append(ds_route)
+            for cid, channel in ds.channels.items():
+                graph[f"{ds_route}/{cid}"] = (
+                    channel.gc_routes() + [ds_route]
+                )
+        for blob_id in self.blobs.ids():
+            graph[f"/_blobs/{blob_id}"] = []
+        return graph, roots
+
+    def set_tombstones(self, tombstones: set[str]) -> None:
+        self.tombstones = set(tombstones)
+
+    def delete_route(self, route: str) -> bool:
+        """Sweep: physically delete an unreferenced node."""
+        parts = route.lstrip("/").split("/")
+        if parts[0] == "_blobs":
+            return self.blobs.delete_blob(parts[1])
+        if len(parts) == 1:
+            return self.datastores.pop(parts[0], None) is not None
+        ds = self.datastores.get(parts[0])
+        if ds is None:
+            return False
+        return ds.channels.pop(parts[1], None) is not None
+
     # ------------------------------------------------------------------
     # inbound (process :1701)
 
@@ -205,6 +272,11 @@ class ContainerRuntime(EventEmitter):
         if envelope.get("kind") == "attach":
             if not local:
                 self._process_attach(envelope)
+            self._advance_all(msg)
+            return
+        if envelope.get("kind") == "blobAttach":
+            contents = envelope["contents"]
+            self.blobs.process_attach(contents["id"], contents["data"])
             self._advance_all(msg)
             return
         ds = self.datastores[envelope["address"]]
@@ -233,7 +305,9 @@ class ContainerRuntime(EventEmitter):
         deduplicated: first attach wins, later ones no-op."""
         ds_id, ch_id = envelope["address"], envelope["channel"]
         if ds_id not in self.datastores:
-            self.create_datastore(ds_id)
+            self.create_datastore(
+                ds_id, root=envelope["contents"].get("root", True)
+            )
         ds = self.datastores[ds_id]
         if ch_id in ds.channels:
             return
@@ -248,8 +322,8 @@ class ContainerRuntime(EventEmitter):
     def _replay_pending(self) -> None:
         self.reconnect_epoch += 1
         for op in self.pending.drain():
-            if op.kind == "attach":
-                self._outbox.append(op)  # attach replays verbatim
+            if op.kind in ("attach", "blobAttach"):
+                self._outbox.append(op)  # announcements replay verbatim
                 continue
             channel = self.datastores[op.datastore_id].channels[
                 op.channel_id
@@ -261,17 +335,33 @@ class ContainerRuntime(EventEmitter):
     # summary (§3.4 client side)
 
     def summarize(self) -> dict:
-        return {
+        out = {
             "datastores": {
                 ds_id: ds.summarize()
                 for ds_id, ds in self.datastores.items()
-            }
+            },
+            "blobs": self.blobs.summarize(),
         }
+        # GC state rides the summary (garbageCollection.ts gcState in
+        # the summary tree): an attached collector contributes fresh
+        # state; otherwise loaded state is carried forward verbatim
+        if self.gc is not None:
+            out["gc"] = self.gc.snapshot()
+        elif self._loaded_gc_state is not None:
+            out["gc"] = self._loaded_gc_state
+        return out
 
     def load(self, summary: dict) -> None:
         for ds_id, ds_summary in summary.get("datastores", {}).items():
-            ds = self.create_datastore(ds_id)
+            ds = self.create_datastore(
+                ds_id, root=ds_summary.get("root", True)
+            )
             ds.load(ds_summary)
+        self.blobs.load(summary.get("blobs", {}))
+        gc_state = summary.get("gc")
+        if gc_state is not None:
+            self._loaded_gc_state = gc_state
+            self.set_tombstones(set(gc_state.get("tombstones", [])))
 
     @property
     def is_dirty(self) -> bool:

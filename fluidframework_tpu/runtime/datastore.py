@@ -43,10 +43,11 @@ class _ChannelServices:
 
 class DataStoreRuntime:
     def __init__(self, container: "ContainerRuntime", datastore_id: str,
-                 registry: ChannelRegistry):
+                 registry: ChannelRegistry, root: bool = True):
         self.container = container
         self.id = datastore_id
         self.registry = registry
+        self.root = root  # GC root (aliased store)
         self.channels: dict[str, SharedObject] = {}
 
     # ------------------------------------------------------------------
@@ -72,6 +73,12 @@ class DataStoreRuntime:
         return channel
 
     def get_channel(self, channel_id: str) -> SharedObject:
+        route = f"/{self.id}/{channel_id}"
+        if route in self.container.tombstones:
+            raise KeyError(
+                f"channel {route} is tombstoned (GC): unreferenced "
+                "past the tombstone timeout"
+            )
         return self.channels[channel_id]
 
     # ------------------------------------------------------------------
@@ -106,13 +113,14 @@ class DataStoreRuntime:
 
     def summarize(self) -> dict:
         return {
+            "root": self.root,
             "channels": {
                 cid: {
                     "type": ch.type_name,
                     "content": ch.summarize_core(),
                 }
                 for cid, ch in self.channels.items()
-            }
+            },
         }
 
     def load(self, summary: dict) -> None:

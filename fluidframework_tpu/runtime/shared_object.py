@@ -94,6 +94,16 @@ class SharedObject(abc.ABC):
             f"{type(self).__name__} does not support stashed ops yet"
         )
 
+    def gc_routes(self) -> list[str]:
+        """Outbound GC edges: handle routes stored in this channel's
+        data (getGCData, garbageCollection.ts:121). Default scans the
+        summary tree for handles; hot channels can override with a
+        cheaper direct scan. Raises if the channel cannot summarize —
+        a failed GC run must abort rather than silently dropping edges
+        (which would eventually sweep live data)."""
+        from .handles import collect_handles
+        return collect_handles(self.summarize_core())
+
     def on_sequence_advance(self, seq: int, min_seq: int) -> None:
         """Called for EVERY sequenced message the container processes
         (not just this channel's ops): collab-window progression. The

@@ -1,0 +1,337 @@
+"""GC (handles, mark/sweep/tombstone), blobs, attribution,
+id-compressor.
+
+Mirrors packages/runtime/garbage-collector tests, container-runtime GC
+tests, blobManager tests, attributor tests, and id-compressor tests.
+"""
+import pytest
+
+from fluidframework_tpu.runtime.attribution import (
+    Attributor,
+    AttributionInfo,
+    OpStreamAttributor,
+)
+from fluidframework_tpu.runtime.gc import (
+    GarbageCollector,
+    run_garbage_collection,
+)
+from fluidframework_tpu.runtime.handles import (
+    FluidHandle,
+    collect_handles,
+    handle_to,
+)
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+from fluidframework_tpu.utils.id_compressor import IdCompressor
+
+
+# ----------------------------------------------------------------------
+# graph BFS
+
+def test_run_garbage_collection_bfs():
+    graph = {
+        "/root": ["/a"],
+        "/a": ["/b"],
+        "/b": [],
+        "/orphan": ["/orphan2"],
+        "/orphan2": [],
+    }
+    referenced, unreferenced = run_garbage_collection(graph, ["/root"])
+    assert referenced == {"/root", "/a", "/b"}
+    assert unreferenced == {"/orphan", "/orphan2"}
+
+
+def test_collect_handles_nested():
+    h1, h2 = handle_to("ds", "ch"), handle_to("other")
+    value = {"a": [1, {"b": h1}], "c": h2, "d": "x"}
+    assert set(collect_handles(value)) == {"/ds/ch", "/other"}
+
+
+# ----------------------------------------------------------------------
+# live runtime GC
+
+def make_session(n=1):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    s = ContainerSession(ids)
+    return s, ids
+
+
+def test_gc_marks_unreferenced_channel_and_revives():
+    s, ids = make_session(1)
+    rt = s.runtime("A")
+    root = rt.create_datastore("root")
+    m = root.create_channel("sharedmap", "index")
+    side = rt.create_datastore("side", root=False)
+    cell = side.create_channel("sharedcell", "c")
+    s.process_all()
+
+    clock = [1000.0]
+    gc = GarbageCollector(rt, tombstone_timeout_s=100,
+                         sweep_timeout_s=200, clock=lambda: clock[0])
+    result = gc.collect()
+    assert "/side" in result.unreferenced
+    assert "/side/c" in result.unreferenced
+    assert "/root" in result.referenced
+
+    # storing a handle revives it
+    m.set("ref", handle_to("side", "c"))
+    s.process_all()
+    result = gc.collect()
+    assert "/side/c" in result.referenced
+    assert "/side" in result.referenced  # child keeps parent alive
+
+
+def test_gc_tombstone_then_sweep():
+    s, ids = make_session(1)
+    rt = s.runtime("A")
+    rt.create_datastore("root").create_channel("sharedmap", "m")
+    side = rt.create_datastore("side", root=False)
+    side.create_channel("sharedcell", "c")
+    s.process_all()
+    clock = [0.0]
+    gc = GarbageCollector(rt, tombstone_timeout_s=100,
+                         sweep_timeout_s=200, clock=lambda: clock[0])
+    gc.collect()
+    clock[0] = 150.0  # past tombstone, before sweep
+    result = gc.collect()
+    assert "/side" in result.tombstoned
+    with pytest.raises(KeyError):
+        rt.get_datastore("side")
+    clock[0] = 250.0
+    result = gc.collect(sweep=True)
+    assert "/side" in result.deleted
+    assert "side" not in rt.datastores
+
+
+def test_gc_state_rides_summary_roundtrip():
+    s, ids = make_session(1)
+    rt = s.runtime("A")
+    rt.create_datastore("root").create_channel("sharedmap", "m")
+    rt.create_datastore("side", root=False)
+    s.process_all()
+    clock = [10.0]
+    gc = GarbageCollector(rt, tombstone_timeout_s=100, clock=lambda: clock[0])
+    gc.collect()
+    state = gc.snapshot()
+    gc2 = GarbageCollector(rt, tombstone_timeout_s=100,
+                          clock=lambda: clock[0])
+    gc2.load(state)
+    assert gc2.unreferenced_since == gc.unreferenced_since
+
+
+# ----------------------------------------------------------------------
+# blobs
+
+def test_blob_upload_dedup_and_remote_fetch():
+    s, ids = ContainerSession(["A", "B"]), ["A", "B"]
+    rt_a, rt_b = s.runtime("A"), s.runtime("B")
+    rt_a.create_datastore("d").create_channel("sharedmap", "m")
+    s.process_all()
+    data = b"binary-payload" * 100
+    h1 = rt_a.blobs.create_blob(data)
+    h2 = rt_a.blobs.create_blob(data)  # dedup: same handle, no new op
+    assert h1 == h2
+    rt_a.get_datastore("d").get_channel("m").set("file", h1)
+    s.process_all()
+    hb = rt_b.get_datastore("d").get_channel("m").get("file")
+    assert isinstance(hb, FluidHandle)
+    assert rt_b.blobs.get_blob(hb) == data
+
+
+def test_blob_gc_sweep_deletes_unreferenced():
+    s, ids = make_session(1)
+    rt = s.runtime("A")
+    m = rt.create_datastore("d").create_channel("sharedmap", "m")
+    s.process_all()
+    h = rt.blobs.create_blob(b"precious")
+    m.set("b", h)
+    s.process_all()
+    clock = [0.0]
+    gc = GarbageCollector(rt, tombstone_timeout_s=10,
+                         sweep_timeout_s=20, clock=lambda: clock[0])
+    assert h.route in gc.collect().referenced
+    m.delete("b")
+    s.process_all()
+    gc.collect()
+    clock[0] = 30.0
+    result = gc.collect(sweep=True)
+    assert h.route in result.deleted
+    assert not rt.blobs.has_blob(h)
+
+
+def test_blob_in_summary_roundtrip():
+    s, ids = make_session(1)
+    rt = s.runtime("A")
+    rt.create_datastore("d").create_channel("sharedmap", "m")
+    h = rt.blobs.create_blob(b"keep me")
+    s.process_all()
+    summary = rt.summarize()
+
+    from fluidframework_tpu.models import default_registry
+    from fluidframework_tpu.runtime import ContainerRuntime
+    fresh = ContainerRuntime(default_registry())
+    fresh.load(summary)
+    assert fresh.blobs.get_blob(h) == b"keep me"
+
+
+def test_nonroot_flag_travels_with_attach():
+    """A non-root store must stay non-root on remote replicas, or GC
+    disagrees across clients."""
+    s = ContainerSession(["A", "B"])
+    side = s.runtime("A").create_datastore("side", root=False)
+    side.create_channel("sharedcell", "c")
+    s.process_all()
+    assert s.runtime("B").datastores["side"].root is False
+
+
+def test_gc_state_travels_via_runtime_summary():
+    s, ids = make_session(1)
+    rt = s.runtime("A")
+    rt.create_datastore("root").create_channel("sharedmap", "m")
+    rt.create_datastore("side", root=False)
+    s.process_all()
+    clock = [0.0]
+    gc = GarbageCollector(rt, tombstone_timeout_s=10,
+                         clock=lambda: clock[0])
+    gc.collect()      # first observation at t=0
+    clock[0] = 50.0
+    gc.collect()      # past the tombstone timeout
+    summary = rt.summarize()
+    assert "/side" in summary["gc"]["tombstones"]
+
+    from fluidframework_tpu.models import default_registry
+    from fluidframework_tpu.runtime import ContainerRuntime
+    fresh = ContainerRuntime(default_registry())
+    fresh.load(summary)
+    with pytest.raises(KeyError):
+        fresh.get_datastore("side")  # tombstone enforced on loaders
+
+
+def test_handle_in_summary_survives_file_roundtrip(tmp_path):
+    from fluidframework_tpu.drivers import load_document, save_document
+
+    s, ids = make_session(1)
+    rt = s.runtime("A")
+    m = rt.create_datastore("d").create_channel("sharedmap", "m")
+    h = rt.blobs.create_blob(b"data")
+    m.set("file", h)
+    s.process_all()
+    path = tmp_path / "doc.json"
+    save_document(path, "doc", [], summary=(1, {"runtime": rt.summarize()}))
+    svc = load_document(path)
+    _, tree = svc.get_latest_summary()
+    assert tree["runtime"]["datastores"]["d"]["channels"]["m"][
+        "content"]["data"]["file"] == h
+
+
+def test_blob_recreate_revives_tombstone():
+    s, ids = make_session(1)
+    rt = s.runtime("A")
+    rt.create_datastore("d").create_channel("sharedmap", "m")
+    s.process_all()
+    h = rt.blobs.create_blob(b"x")
+    clock = [0.0]
+    gc = GarbageCollector(rt, tombstone_timeout_s=10,
+                         clock=lambda: clock[0])
+    gc.collect()
+    clock[0] = 20.0
+    gc.collect()
+    assert h.route in rt.tombstones
+    h2 = rt.blobs.create_blob(b"x")
+    assert rt.blobs.get_blob(h2) == b"x"  # readable immediately
+
+
+# ----------------------------------------------------------------------
+# attribution
+
+def test_attributor_roundtrip_encoding():
+    a = Attributor()
+    a.record(1, AttributionInfo("alice", 100.0))
+    a.record(2, AttributionInfo("bob", 101.0))
+    a.record(3, AttributionInfo("alice", 102.0))
+    decoded = Attributor.decode(a.encode())
+    assert decoded.get(1) == AttributionInfo("alice", 100.0)
+    assert decoded.get(3).user == "alice"
+    assert len(decoded) == 3
+
+
+def test_op_stream_attribution_with_sharedstring():
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.service.local_server import LocalServer
+
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    a = Container.load(factory.create_document_service("doc"),
+                       client_id="alice")
+    b = Container.load(factory.create_document_service("doc"),
+                       client_id="bob")
+    attr = OpStreamAttributor(a)
+    sa = a.runtime.create_datastore("d").create_channel(
+        "sharedstring", "t")
+    a.flush()
+    sa.insert_text(0, "aaa")
+    a.flush()
+    sb = b.runtime.get_datastore("d").get_channel("t")
+    sb.insert_text(3, "BBB")
+    b.flush()
+    # who wrote position 0 vs position 4?
+    assert attr.get(sa.attribution_at(0)).user == "alice"
+    assert attr.get(sa.attribution_at(4)).user == "bob"
+
+
+# ----------------------------------------------------------------------
+# id compressor
+
+def test_id_compressor_local_then_final():
+    c = IdCompressor("session-a", cluster_capacity=8)
+    ids = [c.generate_compressed_id() for _ in range(3)]
+    assert ids == [-1, -2, -3]
+    rng = c.take_next_creation_range()
+    assert rng.count == 3
+    c.finalize_creation_range(rng)
+    finals = [c.normalize_to_op_space(i) for i in ids]
+    assert finals == [0, 1, 2]
+    assert c.normalize_to_session_space(1) == -2
+
+
+def test_id_compressor_two_sessions_agree():
+    """Two replicas finalizing the same ranges in the same order
+    assign identical final ids."""
+    a = IdCompressor("session-a", cluster_capacity=4)
+    b = IdCompressor("session-b", cluster_capacity=4)
+    a_ids = [a.generate_compressed_id() for _ in range(2)]
+    b_ids = [b.generate_compressed_id() for _ in range(2)]
+    ra = a.take_next_creation_range()
+    rb = b.take_next_creation_range()
+    # sequenced order: ra then rb, applied on both replicas
+    for comp in (a, b):
+        comp.finalize_creation_range(ra)
+        comp.finalize_creation_range(rb)
+    assert [a.normalize_to_op_space(i) for i in a_ids] == [0, 1]
+    # b's ids landed in the second cluster on both replicas
+    assert [b.normalize_to_op_space(i) for i in b_ids] == [4, 5]
+    assert a.decompress(4) == b.decompress(b_ids[0])
+
+
+def test_id_compressor_cluster_reuse_and_expansion():
+    c = IdCompressor("s", cluster_capacity=4)
+    first = [c.generate_compressed_id() for _ in range(2)]
+    c.finalize_creation_range(c.take_next_creation_range())
+    more = [c.generate_compressed_id() for _ in range(2)]
+    c.finalize_creation_range(c.take_next_creation_range())
+    # all four fit the first cluster: contiguous finals
+    finals = [c.normalize_to_op_space(i) for i in first + more]
+    assert finals == [0, 1, 2, 3]
+    overflow = [c.generate_compressed_id() for _ in range(2)]
+    c.finalize_creation_range(c.take_next_creation_range())
+    finals2 = [c.normalize_to_op_space(i) for i in overflow]
+    assert finals2 == [4, 5]  # new cluster, next block
+
+
+def test_id_compressor_snapshot_restore():
+    c = IdCompressor("s", cluster_capacity=4)
+    ids = [c.generate_compressed_id() for _ in range(3)]
+    c.finalize_creation_range(c.take_next_creation_range())
+    restored = IdCompressor.restore(c.snapshot(), "other-session")
+    assert restored.decompress(2) == c.decompress(ids[2])
+    assert restored.normalize_to_session_space(1) == 1  # not its own
