@@ -1,0 +1,25 @@
+"""Framework/API layer: the app-facing surface.
+
+Reference analogue: packages/framework/* (aqueduct, fluid-static,
+undo-redo) + the service clients (tinylicious-client/azure-client).
+"""
+from .clients import ContainerServices, LocalServiceClient
+from .data_object import DataObject, DataObjectFactory, PureDataObject
+from .fluid_static import FluidContainer
+from .undo_redo import (
+    SharedMapUndoRedoHandler,
+    SharedStringUndoRedoHandler,
+    UndoRedoStackManager,
+)
+
+__all__ = [
+    "ContainerServices",
+    "DataObject",
+    "DataObjectFactory",
+    "FluidContainer",
+    "LocalServiceClient",
+    "PureDataObject",
+    "SharedMapUndoRedoHandler",
+    "SharedStringUndoRedoHandler",
+    "UndoRedoStackManager",
+]

@@ -89,8 +89,12 @@ class SharedMap(SharedObject, EventEmitter):
 
     # ---- public API (map.ts surface)
 
+    _MISSING = object()  # "key absent" sentinel in previous-value slots
+
     def set(self, key: str, value: Any) -> None:
+        previous = self._kernel.data.get(key, self._MISSING)
         self.submit_local_message(self._kernel.set_local(key, value))
+        self.emit("valueChanged", key, True, previous)
 
     def get(self, key: str, default: Any = None) -> Any:
         return self._kernel.data.get(key, default)
@@ -99,10 +103,17 @@ class SharedMap(SharedObject, EventEmitter):
         return key in self._kernel.data
 
     def delete(self, key: str) -> None:
+        previous = self._kernel.data.get(key, self._MISSING)
         self.submit_local_message(self._kernel.delete_local(key))
+        # deleting an absent key changes nothing locally: no event
+        # (the op still travels — the key may exist remotely)
+        if previous is not self._MISSING:
+            self.emit("valueChanged", key, True, previous)
 
     def clear(self) -> None:
+        previous = dict(self._kernel.data)
         self.submit_local_message(self._kernel.clear_local())
+        self.emit("cleared", True, previous)
 
     def keys(self) -> Iterator[str]:
         return iter(self._kernel.data)
@@ -117,9 +128,24 @@ class SharedMap(SharedObject, EventEmitter):
 
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
-        changed = self._kernel.process(msg.contents, local)
-        if changed is not None:
-            self.emit("valueChanged", changed, local)
+        op = msg.contents
+        if local:
+            self._kernel.process(op, True)  # pending bookkeeping only
+            return
+        if op.get("type") == "clear":
+            # what a remote clear actually removes: everything except
+            # pending-local survivors
+            previous = {
+                k: v for k, v in self._kernel.data.items()
+                if k not in self._kernel._pending_keys
+            }
+        else:
+            previous = self._kernel.data.get(op.get("key"), self._MISSING)
+        changed = self._kernel.process(op, False)
+        if changed == "*":
+            self.emit("cleared", local, previous)
+        elif changed is not None:
+            self.emit("valueChanged", changed, local, previous)
 
     def summarize_core(self) -> dict:
         return {"data": dict(self._kernel.data)}

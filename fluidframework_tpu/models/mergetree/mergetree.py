@@ -463,6 +463,64 @@ class MergeTree:
                 parts.append(seg.text)
         return "".join(parts)
 
+    def span_content(self, start: int, end: int) -> list[tuple]:
+        """Visible content items covering [start, end): ("text", str)
+        runs and ("marker", ref_type, props) singletons — position-
+        accurate (markers occupy one position, unlike get_text), so
+        undo capture can faithfully restore a removed span."""
+        out: list[tuple] = []
+        acc = 0
+        cur = self.collab.current_seq
+        viewer = self.collab.client_id
+        for seg in self.segments:
+            if acc >= end:
+                break
+            length = self._length_at(seg, cur, viewer)
+            if not length:
+                continue
+            lo = max(start, acc)
+            hi = min(end, acc + length)
+            if lo < hi:
+                if seg.is_marker:
+                    out.append((
+                        "marker", seg.marker.get("refType", 0),
+                        dict(seg.props) if seg.props else None,
+                    ))
+                else:
+                    piece = seg.text[lo - acc:hi - acc]
+                    if out and out[-1][0] == "text":
+                        out[-1] = ("text", out[-1][1] + piece)
+                    else:
+                        out.append(("text", piece))
+            acc += length
+        return out
+
+    def span_props(self, start: int, end: int,
+                   keys: list[str]) -> list[tuple[int, int, dict]]:
+        """Per-subrange prior values of ``keys`` over [start, end) —
+        (lo, hi, {key: old_value_or_None}) for annotate undo capture."""
+        out: list[tuple[int, int, dict]] = []
+        acc = 0
+        cur = self.collab.current_seq
+        viewer = self.collab.client_id
+        for seg in self.segments:
+            if acc >= end:
+                break
+            length = self._length_at(seg, cur, viewer)
+            if not length:
+                continue
+            lo = max(start, acc)
+            hi = min(end, acc + length)
+            if lo < hi:
+                props = seg.props or {}
+                old = {k: props.get(k) for k in keys}
+                if out and out[-1][1] == lo and out[-1][2] == old:
+                    out[-1] = (out[-1][0], hi, old)
+                else:
+                    out.append((lo, hi, old))
+            acc += length
+        return out
+
     def segment_at(
         self,
         pos: int,

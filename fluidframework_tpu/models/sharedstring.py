@@ -46,19 +46,37 @@ class SharedString(SharedObject, EventEmitter):
                     props: Optional[dict] = None) -> None:
         op = self.client.insert_text_local(pos, text, props)
         self.submit_local_message(op)
+        # revert info for undo handlers (sequence undo-redo handler)
+        if self.listener_count("localEdit"):
+            self.emit("localEdit", "insert", pos, len(text))
 
     def insert_marker(self, pos: int, ref_type: int,
                       props: Optional[dict] = None) -> None:
         op = self.client.insert_marker_local(pos, ref_type, props)
         self.submit_local_message(op)
+        if self.listener_count("localEdit"):
+            self.emit("localEdit", "insert", pos, 1)
 
     def remove_text(self, start: int, end: int) -> None:
+        # capture BEFORE the removal, position-accurate incl. markers
+        removed = (
+            self.client.mergetree.span_content(start, end)
+            if self.listener_count("localEdit") else None
+        )
         op = self.client.remove_range_local(start, end)
         self.submit_local_message(op)
+        if removed is not None:
+            self.emit("localEdit", "remove", start, removed)
 
     def annotate_range(self, start: int, end: int, props: dict) -> None:
+        prior = (
+            self.client.mergetree.span_props(start, end, list(props))
+            if self.listener_count("localEdit") else None
+        )
         op = self.client.annotate_range_local(start, end, props)
         self.submit_local_message(op)
+        if prior is not None:
+            self.emit("localEdit", "annotate", start, prior)
 
     def get_text(self) -> str:
         return self.client.get_text()

@@ -1,0 +1,253 @@
+"""Framework layer: DataObject, FluidContainer, LocalServiceClient,
+undo-redo.
+
+Mirrors aqueduct/fluid-static/undo-redo tests and the tinylicious
+client e2e pattern (create container -> second client gets it).
+"""
+import pytest
+
+from fluidframework_tpu.framework import (
+    DataObject,
+    DataObjectFactory,
+    FluidContainer,
+    LocalServiceClient,
+    SharedMapUndoRedoHandler,
+    SharedStringUndoRedoHandler,
+    UndoRedoStackManager,
+)
+
+
+# ----------------------------------------------------------------------
+# client + FluidContainer
+
+SCHEMA = {"kv": "sharedmap", "text": "sharedstring"}
+
+
+def test_create_and_get_container_roundtrip():
+    client = LocalServiceClient()
+    created, services, doc_id = client.create_container(SCHEMA)
+    created.initial_objects["kv"].set("hello", "world")
+    created.initial_objects["text"].insert_text(0, "shared text")
+    created.container.flush()
+
+    got, services2 = client.get_container(doc_id, SCHEMA)
+    assert got.initial_objects["kv"].get("hello") == "world"
+    assert got.initial_objects["text"].get_text() == "shared text"
+    # audience sees both clients
+    assert services2.audience.size == 2
+
+
+def test_two_clients_collaborate_via_fluid_container():
+    client = LocalServiceClient()
+    c1, _, doc_id = client.create_container(SCHEMA)
+    c2, _ = client.get_container(doc_id, SCHEMA)
+    c1.initial_objects["text"].insert_text(0, "alpha")
+    c1.container.flush()
+    c2.initial_objects["text"].insert_text(5, "-beta")
+    c2.container.flush()
+    assert c1.initial_objects["text"].get_text() == "alpha-beta"
+
+
+def test_dynamic_dds_creation():
+    client = LocalServiceClient()
+    c1, _, doc_id = client.create_container(SCHEMA)
+    extra = c1.create_dds("sharedcounter", "clicks")
+    extra.increment(5)
+    c1.container.flush()
+    c2, _ = client.get_container(doc_id, SCHEMA)
+    got = c2.container.runtime.get_datastore(
+        "initial-objects").get_channel("clicks")
+    assert got.value == 5
+
+
+# ----------------------------------------------------------------------
+# DataObject
+
+class Counter(DataObject):
+    def initializing_first_time(self):
+        self.root.set("count", 0)
+        self.created_fresh = True
+
+    def initializing_from_existing(self):
+        self.created_fresh = False
+
+    def increment(self):
+        self.root.set("count", self.root.get("count") + 1)
+
+    @property
+    def count(self):
+        return self.root.get("count")
+
+
+def test_data_object_lifecycle():
+    client = LocalServiceClient()
+    c1, _, doc_id = client.create_container({})
+    factory = DataObjectFactory("counter", Counter)
+    obj = factory.create(c1.container.runtime)
+    assert obj.created_fresh and obj.count == 0
+    obj.increment()
+    obj.increment()
+    c1.container.flush()
+
+    c2, _ = client.get_container(doc_id, {})
+    obj2 = factory.load(c2.container.runtime)
+    assert obj2.created_fresh is False
+    assert obj2.count == 2
+
+
+# ----------------------------------------------------------------------
+# undo-redo
+
+def make_collab():
+    client = LocalServiceClient()
+    c1, _, doc_id = client.create_container(SCHEMA)
+    c2, _ = client.get_container(doc_id, SCHEMA)
+    return c1, c2
+
+
+def test_map_undo_redo():
+    c1, c2 = make_collab()
+    kv = c1.initial_objects["kv"]
+    stack = UndoRedoStackManager()
+    SharedMapUndoRedoHandler(stack, kv)
+    kv.set("a", 1)
+    stack.close_current_operation()
+    kv.set("a", 2)
+    stack.close_current_operation()
+    c1.container.flush()
+    assert stack.undo_operation()
+    assert kv.get("a") == 1
+    assert stack.undo_operation()
+    assert kv.get("a") is None
+    assert stack.redo_operation()
+    assert kv.get("a") == 1
+    assert stack.redo_operation()
+    assert kv.get("a") == 2
+    c1.container.flush()
+    assert c2.initial_objects["kv"].get("a") == 2
+
+
+def test_map_clear_undo():
+    c1, c2 = make_collab()
+    kv = c1.initial_objects["kv"]
+    stack = UndoRedoStackManager()
+    SharedMapUndoRedoHandler(stack, kv)
+    kv.set("x", 1)
+    kv.set("y", 2)
+    stack.close_current_operation()
+    kv.clear()
+    stack.close_current_operation()
+    assert stack.undo_operation()
+    assert kv.get("x") == 1 and kv.get("y") == 2
+
+
+def test_string_undo_redo():
+    c1, c2 = make_collab()
+    text = c1.initial_objects["text"]
+    stack = UndoRedoStackManager()
+    SharedStringUndoRedoHandler(stack, text)
+    text.insert_text(0, "hello")
+    stack.close_current_operation()
+    text.insert_text(5, " world")
+    stack.close_current_operation()
+    text.remove_text(0, 5)
+    stack.close_current_operation()
+    c1.container.flush()
+    assert text.get_text() == " world"
+    stack.undo_operation()
+    assert text.get_text() == "hello world"
+    stack.undo_operation()
+    assert text.get_text() == "hello"
+    stack.redo_operation()
+    assert text.get_text() == "hello world"
+    stack.redo_operation()
+    c1.container.flush()
+    assert text.get_text() == " world"
+    assert c2.initial_objects["text"].get_text() == " world"
+
+
+def test_string_undo_with_concurrent_remote_edit():
+    """The undo target slides under a concurrent remote insert."""
+    c1, c2 = make_collab()
+    t1 = c1.initial_objects["text"]
+    t2 = c2.initial_objects["text"]
+    stack = UndoRedoStackManager()
+    SharedStringUndoRedoHandler(stack, t1)
+    t1.insert_text(0, "base ")
+    c1.container.flush()
+    stack.close_current_operation()
+    t1.insert_text(5, "MISTAKE ")
+    stack.close_current_operation()
+    c1.container.flush()
+    t2.insert_text(0, ">> ")  # remote edit shifts everything
+    c2.container.flush()
+    assert t1.get_text() == ">> base MISTAKE "
+    stack.undo_operation()
+    c1.container.flush()
+    assert t1.get_text() == ">> base "
+    assert t2.get_text() == ">> base "
+
+
+def test_string_remove_undo_restores_markers():
+    """A removed span containing a marker restores text AND marker."""
+    c1, _ = make_collab()
+    text = c1.initial_objects["text"]
+    stack = UndoRedoStackManager()
+    SharedStringUndoRedoHandler(stack, text)
+    text.insert_text(0, "ab")
+    text.insert_marker(2, 7, {"tag": "hr"})
+    text.insert_text(3, "cd")
+    stack.close_current_operation()
+    sig_before = text.signature()
+    text.remove_text(1, 4)  # removes 'b', the marker, 'c'
+    stack.close_current_operation()
+    c1.container.flush()
+    assert text.get_text() == "ad"
+    stack.undo_operation()
+    c1.container.flush()
+    assert text.signature() == sig_before
+    assert text.get_text() == "abcd"
+
+
+def test_string_annotate_undo_restores_prior_props():
+    c1, _ = make_collab()
+    text = c1.initial_objects["text"]
+    stack = UndoRedoStackManager()
+    SharedStringUndoRedoHandler(stack, text)
+    text.insert_text(0, "hello world")
+    text.annotate_range(0, 5, {"bold": True})
+    stack.close_current_operation()
+    sig_before = text.signature()
+    text.annotate_range(3, 8, {"bold": False, "em": True})
+    stack.close_current_operation()
+    c1.container.flush()
+    stack.undo_operation()
+    c1.container.flush()
+    assert text.signature() == sig_before
+
+
+def test_map_delete_absent_key_is_not_undoable():
+    c1, _ = make_collab()
+    kv = c1.initial_objects["kv"]
+    stack = UndoRedoStackManager()
+    SharedMapUndoRedoHandler(stack, kv)
+    kv.set("real", 1)
+    stack.close_current_operation()
+    stack.undo_operation()
+    assert stack.redo_count == 1
+    kv.delete("ghost")  # no-op: must not destroy redo history
+    assert stack.redo_count == 1
+    assert stack.undo_count == 0
+
+
+def test_new_edit_clears_redo():
+    c1, _ = make_collab()
+    kv = c1.initial_objects["kv"]
+    stack = UndoRedoStackManager()
+    SharedMapUndoRedoHandler(stack, kv)
+    kv.set("a", 1)
+    stack.close_current_operation()
+    stack.undo_operation()
+    assert stack.redo_count == 1
+    kv.set("b", 9)  # a new edit invalidates redo history
+    assert stack.redo_count == 0
