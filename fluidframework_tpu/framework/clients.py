@@ -53,7 +53,12 @@ class LocalServiceClient:
         self._counter = itertools.count()
 
     def _client_id(self) -> str:
-        return f"{self._user_id}-{next(self._counter)}"
+        # uuid suffix: ids must be unique across client instances
+        # sharing one server, or peers' ops read as local acks
+        return (
+            f"{self._user_id}-{next(self._counter)}-"
+            f"{uuid.uuid4().hex[:8]}"
+        )
 
     def create_container(self, schema: dict[str, str]
                          ) -> tuple[FluidContainer, ContainerServices, str]:

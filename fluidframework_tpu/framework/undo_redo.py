@@ -51,22 +51,53 @@ class MapClearRevertible:
             self.map.set(key, value)
 
 
+class _TrackingGroup:
+    """Follows tracked segments through splits: merge-tree appends
+    split tails to every entry in ``segment.groups`` (the reference's
+    TrackingGroup mechanism, used by its sequence undo handler)."""
+
+    __slots__ = ("segments",)
+
+    def __init__(self) -> None:
+        self.segments: list = []
+
+
 class StringInsertRevertible:
-    """Undo of a text/marker insert: remove the inserted range,
-    tracked with sliding references so remote edits move it."""
+    """Undo of a text/marker insert: remove exactly the inserted
+    segments (tracked through splits), never remote content that
+    landed inside the range afterwards."""
 
     def __init__(self, string: "SharedString", pos: int, length: int):
         self.string = string
-        self.start_ref = string.client.create_reference(
-            pos, ReferenceType.SLIDE_ON_REMOVE
-        )
-        self.length = length
+        self.track = _TrackingGroup()
+        tree = string.client.mergetree
+        cur = tree.collab.current_seq
+        viewer = tree.collab.client_id
+        acc = 0
+        end = pos + length
+        for seg in tree.segments:
+            if acc >= end:
+                break
+            seg_len = tree._length_at(seg, cur, viewer) or 0
+            if seg_len and acc + seg_len > pos:
+                self.track.segments.append(seg)
+                seg.groups.append(self.track)
+            acc += seg_len
 
     def revert(self) -> None:
-        start = self.string.client.reference_position(self.start_ref)
-        if start == DETACHED_POSITION:
-            return  # the inserted content is already gone
-        self.string.remove_text(start, start + self.length)
+        tree = self.string.client.mergetree
+        cur = tree.collab.current_seq
+        viewer = tree.collab.client_id
+        for seg in list(self.track.segments):
+            seg.groups = [g for g in seg.groups if g is not self.track]
+            if seg.removed:
+                continue  # someone else already removed it
+            length = tree._length_at(seg, cur, viewer)
+            if not length:
+                continue
+            start = tree.get_offset(seg, cur, viewer)
+            self.string.remove_text(start, start + length)
+        self.track.segments.clear()
 
 
 class StringRemoveRevertible:

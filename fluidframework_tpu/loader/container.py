@@ -15,6 +15,7 @@ ContainerRuntime, and stamps outbound ops with csn/refSeq.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Optional
 
 from ..drivers.definitions import DocumentService
@@ -35,10 +36,23 @@ from .scheduler import DeltaScheduler, ScheduleManager
 class Container(EventEmitter):
     def __init__(self, service: DocumentService,
                  registry: Optional[ChannelRegistry] = None,
-                 client_id: str = ""):
+                 client_id: str = "",
+                 mc: Optional["MonitoringContext"] = None):
+        from ..utils.config import MonitoringContext
+        from ..utils.telemetry import (
+            SampledTelemetryHelper,
+            TelemetryLogger,
+        )
         super().__init__()
         self.service = service
         self.client_id = client_id
+        # telemetry/config travel together (mixinMonitoringContext)
+        self.mc = mc or MonitoringContext(TelemetryLogger())
+        self._sent_times: dict[int, float] = {}
+        # op-roundtrip latency, sampled (connectionTelemetry.ts:288)
+        self._op_latency = SampledTelemetryHelper(
+            self.mc.logger, "opRoundtripTime", sample_every=20,
+        )
         self.runtime = ContainerRuntime(registry or default_registry())
         self.runtime.set_submit_fn(self._submit_runtime_op)
         self.protocol = ProtocolOpHandler()
@@ -46,6 +60,14 @@ class Container(EventEmitter):
         self._connection = None
         self._csn = 0
         self.closed = False
+        # feature gates read ad hoc from config (config.ts pattern,
+        # e.g. containerRuntime.ts:1704)
+        compression_min = self.mc.config.get_number("compressionMinSize")
+        if compression_min is not None:
+            self.runtime.compressor.min_size = int(compression_min)
+        chunk_size = self.mc.config.get_number("chunkSize")
+        if chunk_size is not None:
+            self.runtime.splitter.chunk_size = int(chunk_size)
         # inbound scheduling: batch integrity + sliced draining
         self._schedule = ScheduleManager()
         self._scheduler = DeltaScheduler(self._process)
@@ -58,8 +80,9 @@ class Container(EventEmitter):
     @classmethod
     def load(cls, service: DocumentService,
              registry: Optional[ChannelRegistry] = None,
-             client_id: str = "", connect: bool = True) -> "Container":
-        container = cls(service, registry, client_id)
+             client_id: str = "", connect: bool = True,
+             mc: Optional["MonitoringContext"] = None) -> "Container":
+        container = cls(service, registry, client_id, mc=mc)
         latest = service.get_latest_summary()
         if latest is not None:
             version_seq, summary = latest
@@ -116,7 +139,11 @@ class Container(EventEmitter):
             self.client_id, self._on_message, self._on_nack
         )
         self._csn = 0
+        self._sent_times.clear()
         self.runtime.set_connection_state(True, self.client_id)
+        self.mc.logger.send_telemetry_event(
+            "connected", clientId=self.client_id,
+        )
         self.emit("connected")
 
     def disconnect(self) -> None:
@@ -125,6 +152,9 @@ class Container(EventEmitter):
             self._connection = None
         self._clear_inbound_state()
         self.runtime.set_connection_state(False)
+        self.mc.logger.send_telemetry_event(
+            "disconnected", clientId=self.client_id,
+        )
         self.emit("disconnected")
 
     def _clear_inbound_state(self) -> None:
@@ -188,6 +218,14 @@ class Container(EventEmitter):
         self.last_processed_seq = msg.sequence_number
         self.protocol.process_message(msg)
         if msg.type == MessageType.OPERATION:
+            if bool(self.client_id) and msg.client_id == self.client_id:
+                sent = self._sent_times.pop(
+                    msg.client_sequence_number, None
+                )
+                if sent is not None:
+                    self._op_latency.record(
+                        (time.monotonic() - sent) * 1000
+                    )
             self.runtime.process(msg)
         else:
             self.runtime.observe_system(msg)
@@ -207,6 +245,7 @@ class Container(EventEmitter):
         if not self.connected:
             return  # stays pending; replayed on reconnect
         self._csn += 1
+        self._sent_times[self._csn] = time.monotonic()
         self._connection.submit(DocumentMessage(
             client_sequence_number=self._csn,
             reference_sequence_number=self.last_processed_seq,

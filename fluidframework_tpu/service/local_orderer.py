@@ -31,8 +31,10 @@ from .sequencer import DocumentSequencer
 class LocalOrderer:
     """One document's ordering service instance."""
 
-    def __init__(self, document_id: str):
+    def __init__(self, document_id: str, lumberjack=None):
+        from .telemetry import Lumberjack
         self.document_id = document_id
+        self.lumberjack = lumberjack or Lumberjack()
         self.op_log = OpLog()
         self.summary_store = SummaryStore()
         self.sequencer = DocumentSequencer(document_id)
@@ -72,6 +74,12 @@ class LocalOrderer:
                op: DocumentMessage) -> Optional[Nack]:
         result = self.sequencer.ticket(client_id, op)
         if result.nack is not None:
+            # structured service telemetry (Lumberjack, lumber.ts:23)
+            self.lumberjack.log("nack", result.nack.message, {
+                "documentId": self.document_id,
+                "clientId": client_id,
+                "errorType": int(result.nack.error_type),
+            })
             return result.nack
         if result.message is not None:
             self._dispatch(result.message)

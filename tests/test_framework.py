@@ -226,6 +226,26 @@ def test_string_annotate_undo_restores_prior_props():
     assert text.signature() == sig_before
 
 
+def test_string_insert_undo_spares_remote_text_inside_range():
+    """Undoing an insert removes only the inserted segments — a
+    remote insert INSIDE the range survives (tracking groups)."""
+    c1, c2 = make_collab()
+    t1 = c1.initial_objects["text"]
+    t2 = c2.initial_objects["text"]
+    stack = UndoRedoStackManager()
+    SharedStringUndoRedoHandler(stack, t1)
+    t1.insert_text(0, "ABCDE")
+    stack.close_current_operation()
+    c1.container.flush()
+    t2.insert_text(2, "xx")  # remote text inside the undone range
+    c2.container.flush()
+    assert t1.get_text() == "ABxxCDE"
+    stack.undo_operation()
+    c1.container.flush()
+    assert t1.get_text() == "xx"
+    assert t2.get_text() == "xx"
+
+
 def test_map_delete_absent_key_is_not_undoable():
     c1, _ = make_collab()
     kv = c1.initial_objects["kv"]

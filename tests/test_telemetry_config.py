@@ -1,0 +1,230 @@
+"""Telemetry + config systems.
+
+Mirrors telemetry-utils tests (logger hierarchy, perf events,
+sampling, config typed getters) and services-telemetry Lumberjack
+tests, plus live wiring through Container and LocalOrderer.
+"""
+import pytest
+
+from fluidframework_tpu.service.telemetry import (
+    InMemoryLumberjackEngine,
+    Lumberjack,
+)
+from fluidframework_tpu.utils.config import (
+    CachedConfigProvider,
+    ConfigProvider,
+    MonitoringContext,
+    mixin_monitoring_context,
+)
+from fluidframework_tpu.utils.telemetry import (
+    ChildLogger,
+    MockLogger,
+    MultiSinkLogger,
+    PerformanceEvent,
+    SampledTelemetryHelper,
+    TaggedTelemetryLogger,
+)
+
+
+# ----------------------------------------------------------------------
+# logger hierarchy
+
+def test_child_logger_namespaces():
+    mock = MockLogger()
+    child = ChildLogger(mock, "loader")
+    grandchild = ChildLogger(child, "container")
+    grandchild.send_telemetry_event("connected", clientId="a")
+    assert mock.events[0]["eventName"] == "loader:container:connected"
+    assert mock.events[0]["clientId"] == "a"
+
+
+def test_multi_sink_fans_out():
+    a, b = MockLogger(), MockLogger()
+    multi = MultiSinkLogger([a])
+    multi.add_sink(b)
+    multi.send_telemetry_event("x")
+    assert a.events and b.events
+
+
+def test_tagged_logger_redacts():
+    mock = MockLogger()
+    tagged = TaggedTelemetryLogger(mock, {"userText"})
+    tagged.send({"eventName": "op", "userText": "secret", "size": 3})
+    assert mock.events[0]["userText"] == "REDACTED"
+    assert mock.events[0]["size"] == 3
+
+
+def test_mock_logger_ordered_subset_match():
+    mock = MockLogger()
+    mock.send_telemetry_event("a", v=1)
+    mock.send_telemetry_event("b", v=2)
+    mock.send_telemetry_event("c")
+    assert mock.matches([{"eventName": "a"}, {"eventName": "c"}])
+    assert not mock.matches([{"eventName": "c"}, {"eventName": "a"}])
+
+
+def test_performance_event_success_and_cancel():
+    mock = MockLogger()
+    with PerformanceEvent(mock, "load", docId="d"):
+        pass
+    assert mock.events[0]["eventName"] == "load_end"
+    assert mock.events[0]["category"] == "performance"
+    assert mock.events[0]["duration"] >= 0
+    with pytest.raises(ValueError):
+        with PerformanceEvent(mock, "load"):
+            raise ValueError("boom")
+    assert mock.events[1]["eventName"] == "load_cancel"
+    assert mock.events[1]["category"] == "error"
+
+
+def test_sampled_helper_aggregates():
+    mock = MockLogger()
+    helper = SampledTelemetryHelper(mock, "opLatency", sample_every=3)
+    for ms in (1.0, 2.0, 3.0):
+        helper.record(ms)
+    assert len(mock.events) == 1
+    event = mock.events[0]
+    assert event["count"] == 3 and event["mean"] == 2.0
+    helper.record(5.0)
+    assert len(mock.events) == 1  # not yet at sample boundary
+    helper.flush()
+    assert mock.events[1]["count"] == 1
+
+
+# ----------------------------------------------------------------------
+# config
+
+def test_cached_config_typed_getters():
+    cfg = CachedConfigProvider(ConfigProvider({
+        "flagTrue": "true", "flagBool": False, "num": "42",
+        "realNum": 7, "name": "prod", "junk": object(),
+    }))
+    assert cfg.get_boolean("flagTrue") is True
+    assert cfg.get_boolean("flagBool") is False
+    assert cfg.get_boolean("num") is None
+    assert cfg.get_number("num") == 42.0
+    assert cfg.get_number("realNum") == 7
+    assert cfg.get_number("name") is None
+    assert cfg.get_string("name") == "prod"
+    assert cfg.get_string("junk") is None
+    assert cfg.get_boolean("missing") is None
+
+
+def test_config_provider_precedence_and_cache():
+    calls = []
+
+    def source(key):
+        calls.append(key)
+        return {"a": 1}.get(key)
+
+    cfg = CachedConfigProvider(
+        ConfigProvider({"a": 99}), ConfigProvider(source)
+    )
+    assert cfg.get_number("a") == 99  # first provider wins
+    assert cfg.get_number("a") == 99
+    assert calls == []  # never consulted, cached
+    assert cfg.get_number("b") is None
+    assert cfg.get_number("b") is None
+    assert calls == ["b"]  # cached miss too
+
+
+def test_monitoring_context_mixin():
+    mock = MockLogger()
+    mc = mixin_monitoring_context(mock, ConfigProvider({"gate": True}))
+    assert isinstance(mc, MonitoringContext)
+    assert mc.config.get_boolean("gate") is True
+
+
+# ----------------------------------------------------------------------
+# lumberjack
+
+def test_lumberjack_metric_lifecycle():
+    engine = InMemoryLumberjackEngine()
+    lj = Lumberjack([engine], {"service": "deli"})
+    metric = lj.new_metric("ticket", {"documentId": "doc"})
+    metric.set_property("clientId", "a")
+    metric.success("sequenced")
+    (lumber,) = engine.events_named("ticket")
+    assert lumber.successful and lumber.duration_ms >= 0
+    assert lumber.properties["service"] == "deli"
+    assert lumber.properties["clientId"] == "a"
+
+
+def test_lumberjack_error_with_exception():
+    engine = InMemoryLumberjackEngine()
+    lj = Lumberjack([engine])
+    metric = lj.new_metric("write")
+    metric.error("failed", exception=RuntimeError("disk"))
+    (lumber,) = engine.emitted
+    assert lumber.successful is False
+    assert "disk" in lumber.properties["exception"]
+
+
+def test_lumber_double_emit_asserts():
+    engine = InMemoryLumberjackEngine()
+    metric = Lumberjack([engine]).new_metric("m")
+    metric.success()
+    with pytest.raises(AssertionError):
+        metric.success()
+
+
+# ----------------------------------------------------------------------
+# live wiring
+
+def test_container_emits_connection_and_latency_telemetry():
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.service.local_server import LocalServer
+
+    mock = MockLogger()
+    mc = mixin_monitoring_context(mock, ConfigProvider({}))
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    c = Container.load(factory.create_document_service("doc"),
+                       client_id="alice", mc=mc)
+    m = c.runtime.create_datastore("d").create_channel("sharedmap", "m")
+    c.flush()
+    for i in range(25):
+        m.set(f"k{i}", i)
+        c.flush()
+    assert mock.matches([{"eventName": "connected"}])
+    perf = [e for e in mock.events
+            if e["eventName"] == "opRoundtripTime"]
+    assert perf and perf[0]["count"] == 20  # sampled aggregation
+    c.disconnect()
+    assert mock.matches([{"eventName": "disconnected"}])
+
+
+def test_container_config_gates_compression():
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.service.local_server import LocalServer
+
+    mc = mixin_monitoring_context(
+        MockLogger(),
+        ConfigProvider({"compressionMinSize": 128, "chunkSize": 4096}),
+    )
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    c = Container.load(factory.create_document_service("doc"),
+                       client_id="a", mc=mc)
+    assert c.runtime.compressor.min_size == 128
+    assert c.runtime.splitter.chunk_size == 4096
+
+
+def test_orderer_logs_nacks_via_lumberjack():
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+    from fluidframework_tpu.service.local_orderer import LocalOrderer
+
+    engine = InMemoryLumberjackEngine()
+    orderer = LocalOrderer("doc", lumberjack=Lumberjack([engine]))
+    nack = orderer.submit("ghost", DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION,
+    ))
+    assert nack is not None
+    (lumber,) = engine.events_named("nack")
+    assert lumber.properties["clientId"] == "ghost"
