@@ -73,6 +73,7 @@ class Container(EventEmitter):
         self._scheduler = DeltaScheduler(self._process)
         self.inbound_paused = False
         self._enqueued_seq = 0
+        self._reconnect_on_nack = False
 
     # ------------------------------------------------------------------
     # load (container.ts load path, §3.3)
@@ -81,7 +82,11 @@ class Container(EventEmitter):
     def load(cls, service: DocumentService,
              registry: Optional[ChannelRegistry] = None,
              client_id: str = "", connect: bool = True,
-             mc: Optional["MonitoringContext"] = None) -> "Container":
+             mc: Optional["MonitoringContext"] = None,
+             replay_trailing: bool = True) -> "Container":
+        """``replay_trailing=False`` loads only the snapshot, leaving
+        trailing-op replay to the caller (replay tool's step-by-step
+        mode)."""
         container = cls(service, registry, client_id, mc=mc)
         latest = service.get_latest_summary()
         if latest is not None:
@@ -111,8 +116,9 @@ class Container(EventEmitter):
             container.last_processed_seq = base_seq
         # catch-up trailing ops from delta storage ("DocumentOpen",
         # deltaManager.ts:451)
-        for msg in service.read_ops(container.last_processed_seq):
-            container._process(msg)
+        if replay_trailing:
+            for msg in service.read_ops(container.last_processed_seq):
+                container._process(msg)
         if connect:
             container.connect()
         return container
@@ -147,6 +153,8 @@ class Container(EventEmitter):
         self.emit("connected")
 
     def disconnect(self) -> None:
+        # an explicit disconnect supersedes any queued nack-reconnect
+        self._reconnect_on_nack = False
         if self._connection is not None:
             self._connection.disconnect()
             self._connection = None
@@ -236,7 +244,18 @@ class Container(EventEmitter):
         self.emit("processed", msg)
 
     def _on_nack(self, nack: Nack) -> None:
+        """A nack means the service dropped our op: the pending queue
+        and csn stream are now misaligned with the service. The
+        reference reconnects and replays pending state
+        (connectionManager.ts nack handling); we tear the connection
+        down immediately (safe mid-submit: later submits of the same
+        flush stay pending) and reconnect at the next flush."""
         self.emit("nack", nack)
+        self.mc.logger.send_error_event(
+            "nack", clientId=self.client_id, reason=nack.message,
+        )
+        self.disconnect()
+        self._reconnect_on_nack = True  # after: disconnect clears it
 
     # ------------------------------------------------------------------
     # outbound (DeltaManager.submit :213)
@@ -255,6 +274,10 @@ class Container(EventEmitter):
         ))
 
     def flush(self) -> None:
+        if self._reconnect_on_nack and not self.closed:
+            self._reconnect_on_nack = False
+            if not self.connected:
+                self.connect()  # replays pending ops with fresh csn
         self.runtime.flush()
 
     # ------------------------------------------------------------------

@@ -1,0 +1,106 @@
+"""Fault-injection driver wrappers.
+
+Reference: packages/test/test-service-load/src/faultInjectionDriver.ts
+(:27,:62,:135,:241,:254) — wrappers over IDocumentService /
+IDocumentDeltaConnection that inject disconnects and error nacks on
+demand or on a schedule, so failure paths (reconnect, resubmit,
+rebase) get exercised under load.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..protocol.messages import (
+    DocumentMessage,
+    Nack,
+    NackErrorType,
+    SequencedMessage,
+)
+
+
+class FaultInjectionConnection:
+    """faultInjectionDriver.ts:135 — a delta connection that can be
+    killed or made to nack on command."""
+
+    def __init__(self, inner, on_nack: Optional[Callable[[Nack], None]]):
+        self._inner = inner
+        self._on_nack = on_nack
+        self.injected_nack_next = 0
+        self.submits = 0
+
+    @property
+    def client_id(self) -> str:
+        return self._inner.client_id
+
+    @property
+    def open(self) -> bool:
+        return self._inner.open
+
+    def submit(self, op: DocumentMessage) -> None:
+        self.submits += 1
+        if self.injected_nack_next > 0:
+            self.injected_nack_next -= 1
+            if self._on_nack is not None:
+                self._on_nack(Nack(
+                    operation=op,
+                    sequence_number=-1,
+                    error_type=NackErrorType.THROTTLING,
+                    message="injected nack",
+                    retry_after_seconds=0.0,
+                ))
+            return  # op dropped, as a throttling service would
+        self._inner.submit(op)
+
+    def disconnect(self) -> None:
+        self._inner.disconnect()
+
+    # ---- injection controls (injectNack/injectDisconnect)
+
+    def inject_disconnect(self) -> None:
+        """Hard-drop the socket without telling the client object."""
+        self._inner.disconnect()
+
+    def inject_nacks(self, count: int = 1) -> None:
+        self.injected_nack_next += count
+
+
+class FaultInjectionDocumentService:
+    """faultInjectionDriver.ts:27 — wraps a DocumentService, tracking
+    live connections so tests can kill them at any moment."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.connections: list[FaultInjectionConnection] = []
+
+    @property
+    def document_id(self) -> str:
+        return self._inner.document_id
+
+    def connect_to_delta_stream(self, client_id, on_message,
+                                on_nack=None):
+        conn = FaultInjectionConnection(
+            self._inner.connect_to_delta_stream(
+                client_id, on_message, on_nack
+            ),
+            on_nack,
+        )
+        self.connections.append(conn)
+        return conn
+
+    def read_ops(self, from_seq, to_seq=None) -> list[SequencedMessage]:
+        return self._inner.read_ops(from_seq, to_seq)
+
+    def get_latest_summary(self):
+        return self._inner.get_latest_summary()
+
+    # ---- injection controls
+
+    @property
+    def live_connections(self) -> list[FaultInjectionConnection]:
+        return [c for c in self.connections if c.open]
+
+    def inject_disconnect_all(self) -> int:
+        live = self.live_connections
+        for conn in live:
+            conn.inject_disconnect()
+        return len(live)

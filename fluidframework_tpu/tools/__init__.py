@@ -1,0 +1,30 @@
+"""Tooling layer: benchmark harness, replay tool, headless runner,
+stress runner.
+
+Reference analogue: tools/benchmark, packages/tools/{replay-tool,
+fluid-runner}, packages/test/test-service-load.
+"""
+from .benchmark import (
+    BenchmarkReporter,
+    BenchmarkResult,
+    BenchmarkType,
+    benchmark,
+)
+from .fluid_runner import export_content, export_file
+from .replay_tool import ReplayReport, replay_document, replay_file
+from .stress import StressConfig, StressReport, run_stress
+
+__all__ = [
+    "BenchmarkReporter",
+    "BenchmarkResult",
+    "BenchmarkType",
+    "ReplayReport",
+    "StressConfig",
+    "StressReport",
+    "benchmark",
+    "export_content",
+    "export_file",
+    "replay_document",
+    "replay_file",
+    "run_stress",
+]

@@ -1,0 +1,195 @@
+"""Stress/load runner with fault injection.
+
+Reference: packages/test/test-service-load — multi-client load runner
+(src/runner.ts, nodeStressTest.ts) with a config (testConfigFile.ts),
+randomized op mixes (optionsMatrix.ts) and fault-injection wrappers.
+
+Seeded and deterministic: the same config always produces the same
+op/fault schedule, so stress failures reproduce (stochastic-test-utils
+discipline, SURVEY §4.2).
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..drivers.local_driver import LocalDocumentServiceFactory
+from ..loader.container import Container
+from ..service.local_server import LocalServer
+from ..testing.fault_injection import FaultInjectionDocumentService
+
+
+@dataclass
+class StressConfig:
+    """testConfigFile.ts shape."""
+
+    n_clients: int = 4
+    n_steps: int = 400
+    seed: int = 0
+    document_id: str = "stress-doc"
+    # op mix weights
+    w_map_set: int = 4
+    w_string_insert: int = 4
+    w_string_remove: int = 2
+    w_flush: int = 6
+    # fault schedule: probability per step of injecting each fault
+    p_disconnect: float = 0.01
+    p_nack: float = 0.01
+    reconnect_after: int = 10  # steps a victim stays down
+
+
+@dataclass
+class StressReport:
+    steps: int = 0
+    ops_submitted: int = 0
+    disconnects_injected: int = 0
+    nacks_injected: int = 0
+    reconnects: int = 0
+    converged: bool = False
+    final_text: str = ""
+    errors: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.converged and not self.errors
+
+
+def run_stress(config: Optional[StressConfig] = None) -> StressReport:
+    cfg = config or StressConfig()
+    rng = random.Random(cfg.seed)
+    report = StressReport()
+
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    services = []
+    containers: list[Container] = []
+    down_until: dict[int, int] = {}  # client index -> step to reconnect
+
+    for i in range(cfg.n_clients):
+        svc = FaultInjectionDocumentService(
+            factory.create_document_service(cfg.document_id)
+        )
+        services.append(svc)
+        c = Container.load(svc, client_id=f"client-{i}")
+        containers.append(c)
+    ds = containers[0].runtime.create_datastore("app")
+    ds.create_channel("sharedmap", "kv")
+    ds.create_channel("sharedstring", "text")
+    containers[0].flush()
+
+    def chan(i: int, name: str):
+        return containers[i].runtime.get_datastore("app").get_channel(name)
+
+    actions = (
+        ["map_set"] * cfg.w_map_set
+        + ["string_insert"] * cfg.w_string_insert
+        + ["string_remove"] * cfg.w_string_remove
+        + ["flush"] * cfg.w_flush
+    )
+
+    for step in range(cfg.n_steps):
+        report.steps = step + 1
+        # scheduled reconnects
+        for i, when in list(down_until.items()):
+            if step >= when:
+                del down_until[i]
+                containers[i].connect()
+                report.reconnects += 1
+        # faults
+        if rng.random() < cfg.p_disconnect:
+            victims = [
+                i for i in range(cfg.n_clients) if i not in down_until
+            ]
+            if len(victims) > 1:  # keep at least one client alive
+                i = rng.choice(victims)
+                containers[i].disconnect()
+                down_until[i] = step + cfg.reconnect_after
+                report.disconnects_injected += 1
+        if rng.random() < cfg.p_nack:
+            i = rng.randrange(cfg.n_clients)
+            if services[i].live_connections:
+                services[i].live_connections[-1].inject_nacks(1)
+                report.nacks_injected += 1
+
+        # a random client acts (offline clients edit too: their ops
+        # enter pending state and replay on reconnect)
+        i = rng.randrange(cfg.n_clients)
+        action = rng.choice(actions)
+        try:
+            if action == "map_set":
+                chan(i, "kv").set(
+                    f"k{rng.randrange(20)}", rng.randrange(1000)
+                )
+                report.ops_submitted += 1
+            elif action == "string_insert":
+                text = chan(i, "text")
+                pos = rng.randrange(text.get_length() + 1)
+                text.insert_text(pos, rng.choice("abcdefgh") * 2)
+                report.ops_submitted += 1
+            elif action == "string_remove":
+                text = chan(i, "text")
+                length = text.get_length()
+                if length > 2:
+                    start = rng.randrange(length - 1)
+                    end = min(length, start + rng.randrange(1, 4))
+                    text.remove_text(start, end)
+                    report.ops_submitted += 1
+            elif action == "flush":
+                containers[i].flush()
+        except Exception as exc:  # noqa: BLE001 - stress harness boundary
+            report.errors.append(f"step {step} {action}: {exc!r}")
+            break
+
+    # drain: reconnect everyone, flush everything
+    for i in list(down_until):
+        containers[i].connect()
+        report.reconnects += 1
+    for c in containers:
+        c.flush()
+    for c in containers:
+        c.flush()  # second pass: resubmitted pending ops
+
+    texts = {c.client_id: (
+        c.runtime.get_datastore("app").get_channel("text").get_text()
+    ) for c in containers}
+    sigs = {c.client_id: repr(
+        c.runtime.get_datastore("app").get_channel("text").signature()
+    ) for c in containers}
+    kvs = {c.client_id: repr(sorted(
+        c.runtime.get_datastore("app").get_channel("kv").items()
+    )) for c in containers}
+    report.converged = (
+        len(set(sigs.values())) == 1 and len(set(kvs.values())) == 1
+    )
+    if not report.converged:
+        report.errors.append(f"divergence: texts={texts}")
+    report.final_text = next(iter(texts.values()))
+    return report
+
+
+def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description="stress runner")
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--steps", type=int, default=400)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    report = run_stress(StressConfig(
+        n_clients=args.clients, n_steps=args.steps, seed=args.seed,
+    ))
+    print(json.dumps({
+        "steps": report.steps,
+        "ops": report.ops_submitted,
+        "disconnects": report.disconnects_injected,
+        "nacks": report.nacks_injected,
+        "converged": report.converged,
+        "errors": report.errors,
+    }))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

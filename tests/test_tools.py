@@ -1,0 +1,187 @@
+"""Tools: benchmark harness, replay tool, headless exporter, fault
+injection, stress runner.
+
+Mirrors tools/benchmark tests, replay-tool validation runs, and
+test-service-load's fault-injection stress pattern.
+"""
+import json
+
+import pytest
+
+from fluidframework_tpu.drivers import (
+    LocalDocumentServiceFactory,
+    save_document,
+)
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.protocol.serialization import load_stream
+from fluidframework_tpu.service.local_server import LocalServer
+from fluidframework_tpu.testing.fault_injection import (
+    FaultInjectionDocumentService,
+)
+from fluidframework_tpu.tools import (
+    BenchmarkType,
+    BenchmarkReporter,
+    StressConfig,
+    benchmark,
+    export_file,
+    replay_file,
+    run_stress,
+)
+
+
+# ----------------------------------------------------------------------
+# benchmark harness
+
+def test_benchmark_runs_and_reports():
+    counter = [0]
+
+    def work():
+        counter[0] += 1
+
+    result = benchmark("noop", work, min_iterations=10,
+                       min_time_s=0.0, warmup=2)
+    assert result.iterations == 10
+    assert counter[0] == 12  # warmup included
+    assert result.mean_s >= 0 and result.p95_s >= result.p50_s >= 0
+    assert result.ops_per_sec > 0
+
+
+def test_benchmark_reporter_renders():
+    reporter = BenchmarkReporter()
+    reporter.add(benchmark(
+        "a", lambda: None, min_iterations=3, min_time_s=0.0,
+        benchmark_type=BenchmarkType.DIAGNOSTIC,
+    ))
+    table = reporter.render_table()
+    assert "a" in table and "ops/s" in table
+    parsed = json.loads(reporter.render_json())
+    assert parsed[0]["type"] == "Diagnostic"
+
+
+def test_benchmark_setup_argument():
+    seen = []
+    result = benchmark(
+        "with-setup", seen.append, setup=lambda: len(seen),
+        min_iterations=3, min_time_s=0.0, warmup=0,
+    )
+    assert result.iterations == 3
+    assert seen == [0, 1, 2]
+
+
+# ----------------------------------------------------------------------
+# record a session then replay/export it
+
+def record_session(tmp_path):
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    a = Container.load(factory.create_document_service("doc"),
+                       client_id="alice")
+    b = Container.load(factory.create_document_service("doc"),
+                       client_id="bob")
+    sa = a.runtime.create_datastore("app").create_channel(
+        "sharedstring", "text")
+    a.flush()
+    sa.insert_text(0, "hello")
+    a.flush()
+    sb = b.runtime.get_datastore("app").get_channel("text")
+    sb.insert_text(5, " world")
+    b.flush()
+    kv = a.runtime.get_datastore("app").create_channel("sharedmap", "kv")
+    a.flush()
+    kv.set("done", True)
+    a.flush()
+    orderer = server.get_orderer("doc")
+    path = tmp_path / "doc.json"
+    save_document(path, "doc", orderer.op_log.read(0))
+    return path, sa.get_text()
+
+
+def test_replay_tool_reproduces_session(tmp_path):
+    path, expected_text = record_session(tmp_path)
+    container, report = replay_file(path)
+    assert report.ok and report.ops_replayed > 0
+    text = container.runtime.get_datastore("app").get_channel("text")
+    assert text.get_text() == expected_text
+
+
+def test_replay_tool_checkpoints_and_validation(tmp_path):
+    path, _ = record_session(tmp_path)
+    _, report = replay_file(path, checkpoint_every=3)
+    assert report.checkpoints
+    # replaying again against recorded checkpoints validates clean
+    _, report2 = replay_file(
+        path, checkpoint_every=3,
+        expected_checkpoints=report.checkpoints,
+    )
+    assert report2.ok
+    # a corrupted expectation is caught
+    bad = [dict(c, summary={"tampered": 1})
+           for c in report.checkpoints]
+    _, report3 = replay_file(
+        path, checkpoint_every=3, expected_checkpoints=bad,
+    )
+    assert not report3.ok
+
+
+def test_fluid_runner_exports_content(tmp_path):
+    path, expected_text = record_session(tmp_path)
+    out_path = tmp_path / "export.json"
+    result = export_file(path, str(out_path))
+    assert result["content"]["app"]["text"]["text"] == expected_text
+    assert result["content"]["app"]["kv"]["content"]["data"]["done"] is True
+    assert json.loads(out_path.read_text()) == result
+
+
+# ----------------------------------------------------------------------
+# fault injection
+
+def test_fault_injection_disconnect_and_recovery():
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    svc = FaultInjectionDocumentService(
+        factory.create_document_service("doc"))
+    a = Container.load(svc, client_id="alice")
+    b = Container.load(factory.create_document_service("doc"),
+                       client_id="bob")
+    m = a.runtime.create_datastore("d").create_channel("sharedmap", "m")
+    a.flush()
+    # kill the socket under alice, edit while down, reconnect
+    svc.inject_disconnect_all()
+    m.set("offline", 1)
+    a.flush()  # goes to pending, connection is dead
+    bm = b.runtime.get_datastore("d").get_channel("m")
+    assert bm.get("offline") is None
+    a.disconnect()  # container notices; clears connection state
+    a.connect()
+    a.flush()
+    assert bm.get("offline") == 1
+
+
+def test_fault_injection_nack():
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    svc = FaultInjectionDocumentService(
+        factory.create_document_service("doc"))
+    nacks = []
+    a = Container.load(svc, client_id="alice")
+    a.on("nack", lambda n: nacks.append(n))
+    m = a.runtime.create_datastore("d").create_channel("sharedmap", "m")
+    a.flush()
+    svc.live_connections[-1].inject_nacks(1)
+    m.set("k", 1)
+    a.flush()
+    assert nacks and nacks[0].message == "injected nack"
+
+
+# ----------------------------------------------------------------------
+# stress
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_stress_run_converges_with_faults(seed):
+    report = run_stress(StressConfig(
+        n_clients=3, n_steps=250, seed=seed,
+        p_disconnect=0.03, p_nack=0.02,
+    ))
+    assert report.ok, report.errors
+    assert report.ops_submitted > 50
+    assert report.disconnects_injected > 0 or seed != 0
