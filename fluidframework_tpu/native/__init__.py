@@ -1,0 +1,98 @@
+"""Native runtime components (C++ via ctypes).
+
+The service plane's hot loops live here; JAX/XLA owns the device
+compute path, C++ owns the host sequencing path (deli ticket —
+SURVEY §3.1 marks it one of the three hot loops). The shared library
+builds on demand with g++ and caches beside the source; every native
+component keeps a pure-Python twin as both fallback and differential
+oracle.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_HERE = Path(__file__).parent
+_SRC = _HERE / "sequencer.cpp"
+_LIB = _HERE / "_sequencer.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[Path]:
+    global _build_error
+    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _LIB
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        str(_SRC), "-o", str(_LIB),
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+    except FileNotFoundError:
+        _build_error = "g++ not found"
+        return None
+    if proc.returncode != 0:
+        _build_error = proc.stderr[-2000:]
+        return None
+    return _LIB
+
+
+def load_native_sequencer() -> Optional[ctypes.CDLL]:
+    """Build (if needed) + load the native core; None when the
+    toolchain is unavailable (callers fall back to Python)."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None  # failure is sticky: don't re-run g++ per call
+        if os.environ.get("FFTPU_DISABLE_NATIVE") == "1":
+            return None
+        path = _build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(str(path))
+        i64, p_i64 = ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)
+        p_i32 = ctypes.POINTER(ctypes.c_int32)
+        lib.seq_create.restype = ctypes.c_void_p
+        lib.seq_create.argtypes = [i64, i64]
+        lib.seq_destroy.argtypes = [ctypes.c_void_p]
+        lib.seq_client_join.restype = i64
+        lib.seq_client_join.argtypes = [ctypes.c_void_p, i64]
+        lib.seq_client_leave.restype = i64
+        lib.seq_client_leave.argtypes = [ctypes.c_void_p, i64]
+        for fn in ("seq_sequence_number", "seq_minimum_sequence_number",
+                   "seq_client_count", "seq_bump"):
+            getattr(lib, fn).restype = i64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.seq_ticket_batch.restype = i64
+        lib.seq_ticket_batch.argtypes = [
+            ctypes.c_void_p, i64, p_i64, p_i64, p_i64,
+            p_i64, p_i64, p_i32,
+        ]
+        lib.seq_export_clients.restype = i64
+        lib.seq_export_clients.argtypes = [
+            ctypes.c_void_p, i64, p_i64, p_i64, p_i64,
+        ]
+        lib.seq_restore_client.argtypes = [ctypes.c_void_p, i64, i64, i64]
+        _lib = lib
+        return _lib
+
+
+def native_build_error() -> Optional[str]:
+    return _build_error
+
+
+from .sequencer_core import NativeSequencerCore  # noqa: E402
+
+__all__ = [
+    "NativeSequencerCore",
+    "load_native_sequencer",
+    "native_build_error",
+]

@@ -32,12 +32,20 @@ class LocalOrderer:
     """One document's ordering service instance."""
 
     def __init__(self, document_id: str, lumberjack=None):
+        import os
+
         from .telemetry import Lumberjack
         self.document_id = document_id
         self.lumberjack = lumberjack or Lumberjack()
         self.op_log = OpLog()
         self.summary_store = SummaryStore()
         self.sequencer = DocumentSequencer(document_id)
+        if os.environ.get("FFTPU_NATIVE_SEQUENCER") == "1":
+            try:
+                from ..native import NativeSequencerCore
+                self.sequencer = NativeSequencerCore(document_id)
+            except (RuntimeError, OSError):
+                pass  # toolchain unavailable: Python path stands in
         self.scriptorium = ScriptoriumLambda(self.op_log)
         self.broadcaster = BroadcasterLambda()
         self.scribe = ScribeLambda(
@@ -91,17 +99,7 @@ class LocalOrderer:
                           contents: Any) -> None:
         """Scribe emits summaryAck/Nack as service-generated sequenced
         ops (scribe -> deli loopback)."""
-        seq = self.sequencer.sequence_number + 1
-        self.sequencer.sequence_number = seq
-        self._dispatch(SequencedMessage(
-            client_id=None,
-            sequence_number=seq,
-            minimum_sequence_number=self.sequencer.minimum_sequence_number,
-            client_sequence_number=-1,
-            reference_sequence_number=-1,
-            type=msg_type,
-            contents=contents,
-        ))
+        self._dispatch(self.sequencer.system_message(msg_type, contents))
 
     def _dispatch(self, msg: SequencedMessage) -> None:
         self._dispatch_queue.append(msg)

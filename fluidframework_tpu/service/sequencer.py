@@ -173,6 +173,12 @@ class DocumentSequencer:
             traces=traces,
         ))
 
+    def system_message(self, msg_type: MessageType,
+                       contents: Any) -> SequencedMessage:
+        """Allocate a seq for a service-generated op (scribe's
+        summaryAck/Nack loop back through deli the same way)."""
+        return self._stamp_system(msg_type, contents, self._next_seq())
+
     # ------------------------------------------------------------------
     # checkpoint / resume (deli/checkpointContext.ts)
 

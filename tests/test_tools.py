@@ -13,7 +13,6 @@ from fluidframework_tpu.drivers import (
     save_document,
 )
 from fluidframework_tpu.loader import Container
-from fluidframework_tpu.protocol.serialization import load_stream
 from fluidframework_tpu.service.local_server import LocalServer
 from fluidframework_tpu.testing.fault_injection import (
     FaultInjectionDocumentService,
