@@ -6,6 +6,12 @@ keeps replicas convergent when a remote ``clear`` interleaves with
 un-acked local ops: every peer applies our op *after* the clear (it
 sequences later), so when the ack arrives we must re-apply any effect
 the clear wiped.
+
+Single-writer-per-stroke (as in the reference's usage model): the
+client that created a stroke is the only one appending points to it.
+Concurrent appends to one stroke by different clients would apply in
+submission order locally but sequenced order remotely — the optimistic
+path is only order-stable for a single writer.
 """
 from __future__ import annotations
 
@@ -70,7 +76,15 @@ class Ink(SharedObject, EventEmitter):
         if local:
             entry = self._pending.popleft()
             assert entry["op"]["type"] == op["type"], "ack out of order"
-            if entry["wiped"]:
+            if op["type"] == "clear":
+                # our clear just sequenced: every remote op applied
+                # since the optimistic wipe sequenced BEFORE it — peers
+                # cleared them; re-wipe to match. Our own later pending
+                # ops sequence after and re-apply on their acks.
+                self._apply(op)
+                for later in self._pending:
+                    later["wiped"] = True
+            elif entry["wiped"]:
                 # a clear sequenced between submit and ack: peers apply
                 # this op after their clear — match them
                 self._apply(op)

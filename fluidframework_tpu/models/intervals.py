@@ -86,6 +86,8 @@ class IntervalCollection:
         self._submit = submit_fn
         self._intervals: dict[str, SequenceInterval] = {}
         self._deleted: set[str] = set()
+        # local deletes awaiting ack: must resubmit after reconnect
+        self._pending_deletes: set[str] = set()
 
     # ------------------------------------------------------------------
     # queries
@@ -147,6 +149,7 @@ class IntervalCollection:
             return
         self._drop_refs(interval)
         self._deleted.add(interval_id)
+        self._pending_deletes.add(interval_id)
         self._submit(IntervalOp(
             label=self.label, action="delete", interval_id=interval_id,
         ))
@@ -241,6 +244,9 @@ class IntervalCollection:
             raise ValueError(f"unknown interval action {op.action!r}")
 
     def _ack_own(self, op: IntervalOp, msg: "SequencedMessage") -> None:
+        if op.action == "delete":
+            self._pending_deletes.discard(op.interval_id)
+            return
         interval = self._intervals.get(op.interval_id)
         if interval is None:
             return  # deleted locally while in flight
@@ -263,6 +269,13 @@ class IntervalCollection:
         endpoints are re-expressed as *current* positions — the sliding
         already incorporated every remote edit seen while offline."""
         out: list[IntervalOp] = []
+        # un-acked deletes resubmit first: peers must stop tracking
+        # the interval regardless of what else changed
+        for interval_id in self._pending_deletes:
+            out.append(IntervalOp(
+                label=self.label, action="delete",
+                interval_id=interval_id,
+            ))
         for interval in list(self._intervals.values()):
             if not interval.has_pending:
                 continue

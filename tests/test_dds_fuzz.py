@@ -1,0 +1,37 @@
+"""Generic DDS fuzz: every channel type through the same engine.
+
+Mirrors packages/dds/test-dds-utils ddsFuzzHarness: seeded action
+mixes, partial sequencing, reconnect churn, convergence asserts —
+parametrized over the whole channel catalogue.
+"""
+import pytest
+
+from fluidframework_tpu.testing.dds_fuzz import (
+    ACTIONS,
+    DdsFuzzConfig,
+    run_dds_fuzz,
+)
+
+CHANNELS = sorted(ACTIONS)
+
+
+@pytest.mark.parametrize("channel_type", CHANNELS)
+@pytest.mark.parametrize("seed", [0, 1])
+def test_dds_fuzz_converges(channel_type, seed):
+    report = run_dds_fuzz(DdsFuzzConfig(
+        channel_type=channel_type, seed=seed, n_steps=220,
+    ))
+    assert report.actions > 30, (
+        f"{channel_type} generator produced too few actions"
+    )
+
+
+@pytest.mark.parametrize("channel_type", ["sharedstring", "sharedmap",
+                                          "sharedmatrix"])
+def test_dds_fuzz_heavy_churn(channel_type):
+    """Higher fault pressure on the structurally hardest DDSes."""
+    report = run_dds_fuzz(DdsFuzzConfig(
+        channel_type=channel_type, seed=99, n_steps=350,
+        p_reconnect_churn=0.06, reconnect_after=8,
+    ))
+    assert report.reconnects > 0
