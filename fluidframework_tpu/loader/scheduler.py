@@ -19,9 +19,11 @@ class ScheduleManager:
     """Groups inbound messages into atomic units: singleton messages
     pass through; messages between a {batch: true} and {batch: false}
     mark from one client release together. System messages interleaved
-    by the service mid-batch pass through immediately (they are not
-    part of the runtime batch); a foreign *operation* mid-batch is a
-    service ordering violation (scheduleManager.ts batch asserts)."""
+    by the service mid-batch are held *in sequence order* inside the
+    open unit (the reference's scheduleManager.ts never reorders — it
+    pauses the inbound queue until the whole batch is present, so
+    nothing downstream ever observes a seq gap); a foreign *operation*
+    mid-batch is a service ordering violation (batch asserts)."""
 
     def __init__(self) -> None:
         self._batch: list[SequencedMessage] = []
@@ -40,7 +42,11 @@ class ScheduleManager:
         flag = batch_flag(msg.metadata)
         if self._batch:
             if msg.type != MessageType.OPERATION:
-                return [msg]  # system traffic rides through
+                # Hold system traffic in seq order within the unit:
+                # Container._process asserts strict seq continuity, so
+                # releasing it ahead of the buffered batch would crash.
+                self._batch.append(msg)
+                return []
             assert msg.client_id == self._batch[0].client_id, (
                 "foreign operation interleaved mid-batch: "
                 f"{msg.client_id!r} inside "

@@ -290,15 +290,38 @@ class IntervalCollection:
                     self._drop_refs(interval)
                     del self._intervals[interval.interval_id]
                 continue
+            if interval.change_seq == 0:
+                # never sequenced: peers have nothing — resend the
+                # whole interval (deleted-then-readded keys are simply
+                # absent; no tombstone needed)
+                out.append(IntervalOp(
+                    label=self.label, action="add",
+                    interval_id=interval.interval_id,
+                    start=start, end=end,
+                    props=dict(interval.props) or None,
+                ))
+                interval.pending_endpoints = 1
+                interval.pending_props = {k: 1 for k in interval.props}
+                continue
+            # sequenced before: resubmit ONLY the pending aspects.
+            # Pending keys whose value is gone locally were *deleted* —
+            # emit an explicit {key: None} so peers drop them too;
+            # untouched keys stay out of the op so concurrent remote
+            # updates to them survive (ADVICE r1 #2).
+            pending_keys = sorted(interval.pending_props)
+            props = (
+                {k: interval.props.get(k) for k in pending_keys} or None
+            )
+            has_endpoints = interval.pending_endpoints > 0
             out.append(IntervalOp(
-                label=self.label, action="add"
-                if interval.change_seq == 0 else "change",
+                label=self.label, action="change",
                 interval_id=interval.interval_id,
-                start=start, end=end,
-                props=dict(interval.props) or None,
+                start=start if has_endpoints else None,
+                end=end if has_endpoints else None,
+                props=props,
             ))
-            interval.pending_endpoints = 1
-            interval.pending_props = {k: 1 for k in interval.props}
+            interval.pending_endpoints = 1 if has_endpoints else 0
+            interval.pending_props = {k: 1 for k in pending_keys}
         return out
 
     # ------------------------------------------------------------------

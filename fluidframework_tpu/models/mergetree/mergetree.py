@@ -421,6 +421,20 @@ class MergeTree:
                         ref.offset += shift
                     prev.local_refs.extend(seg.local_refs)
                     seg.local_refs = []
+                # keep per-offset authorship across the merge
+                # (attributionCollection.ts preserves keys; ADVICE r1)
+                if (
+                    prev.attribution is not None
+                    or seg.attribution is not None
+                    or prev.seq != seg.seq
+                ):
+                    shift = len(prev.text)
+                    runs = list(prev._attribution_runs())
+                    for s, k in seg._attribution_runs():
+                        if runs and runs[-1][1] == k:
+                            continue  # extend the last run
+                        runs.append((s + shift, k))
+                    prev.attribution = runs
                 prev.text = prev.text + seg.text
                 prev.seq = max(prev.seq, seg.seq)
             else:

@@ -54,6 +54,29 @@ class Segment:
     # follow splits and slide on removal/zamboni — see mergetree.py
     local_refs: list = field(default_factory=list)
 
+    # per-offset attribution runs (attributionCollection.ts:56):
+    # ``None`` means the whole segment is attributed to ``seq``;
+    # otherwise a run-length list [(start_offset, seq_key), ...] kept
+    # across zamboni merges of segments from different ops
+    attribution: Optional[list] = None
+
+    def attribution_key(self, offset: int) -> int:
+        """Attribution key (insert seq) for the character at offset."""
+        if self.attribution is None:
+            return self.seq
+        key = self.attribution[0][1]
+        for start, k in self.attribution:
+            if start > offset:
+                break
+            key = k
+        return key
+
+    def _attribution_runs(self) -> list:
+        return (
+            [(0, self.seq)] if self.attribution is None
+            else self.attribution
+        )
+
     @property
     def length(self) -> int:
         if self.text is not None:
@@ -97,6 +120,17 @@ class Segment:
             ),
             groups=list(self.groups),
         )
+        if self.attribution is not None:
+            head = [(s, k) for s, k in self.attribution if s < offset]
+            tail_runs = []
+            carry = self.attribution_key(offset)
+            for s, k in self.attribution:
+                if s >= offset:
+                    tail_runs.append((s - offset, k))
+            if not tail_runs or tail_runs[0][0] != 0:
+                tail_runs.insert(0, (0, carry))
+            self.attribution = head
+            tail.attribution = tail_runs
         self.text = self.text[:offset]
         for group in self.groups:
             group.segments.append(tail)

@@ -102,8 +102,9 @@ class SharedString(SharedObject, EventEmitter):
         (attributionCollection.ts keys == segment seqs). ``None`` for
         locally-inserted text whose op has not sequenced yet (no
         authorship record exists anywhere until the ack)."""
-        seg, _ = self.client.mergetree.segment_at(pos)
-        return None if seg.seq == UNASSIGNED_SEQ else seg.seq
+        seg, off = self.client.mergetree.segment_at(pos)
+        key = seg.attribution_key(off)
+        return None if key == UNASSIGNED_SEQ else key
 
     def create_position_reference(self, pos: int, ref_type: int):
         """Public cursor-anchor API (sharedString createLocalReference
@@ -201,6 +202,14 @@ class SharedString(SharedObject, EventEmitter):
                     if 0 <= c < len(self.client._short_to_long)
                 ],
                 "props": seg.props,
+                # per-offset authorship runs survive zamboni merges —
+                # persist them or reload collapses attribution to the
+                # merged segment's max seq (attributionCollection.ts
+                # keys are part of the snapshot)
+                "attribution": (
+                    [list(run) for run in seg.attribution]
+                    if seg.attribution is not None else None
+                ),
             })
         return {
             "segments": segments,
@@ -229,6 +238,10 @@ class SharedString(SharedObject, EventEmitter):
                     self.client.intern(c) for c in entry["removedClients"]
                 ],
                 props=dict(entry["props"]) if entry["props"] else None,
+                attribution=(
+                    [tuple(run) for run in entry["attribution"]]
+                    if entry.get("attribution") else None
+                ),
             )
             tree.segments.append(seg)
         for label, entries in summary.get("intervals", {}).items():

@@ -335,3 +335,64 @@ def test_id_compressor_snapshot_restore():
     restored = IdCompressor.restore(c.snapshot(), "other-session")
     assert restored.decompress(2) == c.decompress(ids[2])
     assert restored.normalize_to_session_space(1) == 1  # not its own
+
+
+def test_attribution_survives_zamboni_merge():
+    """ADVICE r1 #3: zamboni merges adjacent below-window segments from
+    different ops/clients; per-offset attribution keys must survive the
+    merge (the reference's AttributionCollection preserves them)."""
+    from fluidframework_tpu.testing import MockCollabSession
+
+    s = MockCollabSession(["A", "B"])
+    a, b = s.client("A"), s.client("B")
+    s.do("A", "insert_text_local", 0, "aaa")
+    s.process_all()
+    s.do("B", "insert_text_local", 3, "BBB")
+    s.process_all()
+    a_key = a.mergetree.segments[0].seq
+    b_key = next(
+        seg.seq for seg in a.mergetree.segments if seg.client_id != 0
+    )
+    # Advance the collab window past both inserts so zamboni merges
+    # the A- and B-authored segments into one run.
+    top = a.mergetree.collab.current_seq
+    for c in (a, b):
+        c.mergetree.update_min_seq(top)
+    assert len(a.mergetree.segments) == 1  # merged
+    merged = a.mergetree.segments[0]
+    assert merged.attribution_key(0) == a_key
+    assert merged.attribution_key(2) == a_key
+    assert merged.attribution_key(3) == b_key
+    assert merged.attribution_key(5) == b_key
+
+
+def test_attribution_survives_summary_roundtrip():
+    """Attribution runs built by zamboni merges must persist through
+    summarize/load (code-review r2 finding)."""
+    from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+    s = ContainerSession(["A", "B"])
+    for cid in ("A", "B"):
+        s.runtime(cid).create_datastore("ds").create_channel(
+            "sharedstring", "text")
+    sa = s.runtime("A").get_datastore("ds").get_channel("text")
+    sb = s.runtime("B").get_datastore("ds").get_channel("text")
+    sa.insert_text(0, "aaa")
+    s.process_all()
+    sb.insert_text(3, "BBB")
+    s.process_all()
+    tree = sa.client.mergetree
+    a_key = tree.segments[0].seq
+    top = tree.collab.current_seq
+    for ss in (sa, sb):
+        ss.client.mergetree.update_min_seq(top)
+    assert len(tree.segments) == 1  # zamboni merged A and B runs
+    summary = sa.summarize_core()
+
+    s2 = ContainerSession(["C"])
+    s2.runtime("C").create_datastore("ds").create_channel(
+        "sharedstring", "text")
+    sc = s2.runtime("C").get_datastore("ds").get_channel("text")
+    sc.load_core(summary)
+    assert sc.attribution_at(0) == a_key
+    assert sc.attribution_at(3) != a_key

@@ -313,3 +313,67 @@ def test_interval_reconnect_resubmits_pending_adds():
         start, end = coll.endpoints(iv)
         assert ss.get_text()[start:end + 1] == "world"
     assert sa.signature() == sb.signature()
+
+
+def test_interval_reconnect_resubmits_pending_prop_deletion():
+    """ADVICE r1 #2: a pending property deletion ({key: None}) must
+    survive reconnect as an explicit None entry, or peers keep the
+    deleted key forever (signature divergence)."""
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "hello world")
+    iv = sa.get_interval_collection("c").add(0, 4, props={"k": 1, "j": 2})
+    s.process_all()
+    s.disconnect("A")
+    sa.get_interval_collection("c").change(
+        iv.interval_id, props={"k": None})  # delete k while offline
+    s.reconnect("A")
+    s.process_all()
+    for ss in (sa, sb):
+        got = ss.get_interval_collection("c").get(iv.interval_id)
+        assert "k" not in got.props, ss
+        assert got.props["j"] == 2
+    assert sa.signature() == sb.signature()
+
+
+def test_interval_reconnect_resubmit_preserves_concurrent_remote_props():
+    """Resubmission must cover ONLY locally-pending keys: a concurrent
+    remote update to an untouched key must not be stomped by the
+    reconnect replay."""
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "hello world")
+    iv = sa.get_interval_collection("c").add(0, 4, props={"x": 1, "y": 1})
+    s.process_all()
+    s.disconnect("A")
+    sa.get_interval_collection("c").change(iv.interval_id, props={"x": 9})
+    sb.get_interval_collection("c").change(iv.interval_id, props={"y": 7})
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    for ss in (sa, sb):
+        got = ss.get_interval_collection("c").get(iv.interval_id)
+        assert got.props == {"x": 9, "y": 7}, ss
+    assert sa.signature() == sb.signature()
+
+
+def test_interval_reconnect_props_only_change_keeps_remote_endpoints():
+    """A pending props-only change must not resubmit endpoints: a
+    concurrent remote endpoint move would otherwise be overwritten."""
+    s, ids = make_session(2)
+    sa, sb = strings(s, ids)
+    sa.insert_text(0, "hello world")
+    iv = sa.get_interval_collection("c").add(0, 4, props={"x": 1})
+    s.process_all()
+    s.disconnect("A")
+    sa.get_interval_collection("c").change(iv.interval_id, props={"x": 2})
+    sb.get_interval_collection("c").change(iv.interval_id, start=6, end=10)
+    s.process_all()
+    s.reconnect("A")
+    s.process_all()
+    for ss in (sa, sb):
+        coll = ss.get_interval_collection("c")
+        got = coll.get(iv.interval_id)
+        assert got.props == {"x": 2}, ss
+        assert coll.endpoints(got) == (6, 10), ss
+    assert sa.signature() == sb.signature()

@@ -168,13 +168,18 @@ def test_schedule_manager_releases_complete_batch():
     assert [m.sequence_number for m in out] == [2, 3, 4]
 
 
-def test_schedule_manager_lets_system_messages_through_mid_batch():
+def test_schedule_manager_holds_system_messages_in_seq_order_mid_batch():
+    """A service-interleaved system message must NOT be released ahead
+    of the still-buffered batch: Container._process asserts strict seq
+    continuity, so reordering would crash (ADVICE r1 #1). The reference
+    scheduleManager.ts pauses the queue until the whole batch is in."""
     sm = ScheduleManager()
     sm.feed(seqmsg(1, metadata=mark_batch(None, True)))
     join = seqmsg(2, client=None, mtype=MessageType.CLIENT_JOIN)
-    assert sm.feed(join) == [join]
+    assert sm.feed(join) == []  # held — not reordered ahead of seq 1
     out = sm.feed(seqmsg(3, metadata=mark_batch(None, False)))
-    assert [m.sequence_number for m in out] == [1, 3]
+    assert [m.sequence_number for m in out] == [1, 2, 3]
+    assert out[1].type == MessageType.CLIENT_JOIN
 
 
 def test_schedule_manager_asserts_foreign_op_mid_batch():
