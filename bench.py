@@ -1,81 +1,130 @@
-"""Benchmark: batched merge-tree op throughput (BASELINE config #2:
-N docs x concurrent clients typing, batched apply).
+"""Benchmark harness: batched merge throughput vs compiled baseline.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": R}
+Prints exactly ONE JSON line on stdout no matter what happens:
 
-``vs_baseline`` is measured against this repo's scalar client replay
-(the host/oracle path — a stand-in for the reference's Node.js
-merge-tree, which cannot be built in this zero-egress image; see
-BASELINE.md).
+  {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": R,
+   "detail": {stages...}}
+
+Architecture (hardened after round 1, where a hung TPU backend produced
+zero evidence):
+
+- The parent process is stdlib-only (never imports jax) and runs each
+  benchmark stage in a SUBPROCESS with a hard timeout — the axon TPU
+  backend can hang indefinitely inside backend init when the tunnel is
+  down, and only process isolation survives that.
+- Each stage is retried on the TPU backend, then falls back to the CPU
+  backend (flagged `"backend": "cpu"` in the output) at reduced sizes
+  so the round always records *a* number plus the failure trail.
+- Baselines: `vs_baseline` compares the batched kernel to the C++ -O2
+  scalar replayer (native/merge_replay.cpp) running the identical
+  sequenced-path semantics on the same host — the stand-in for the
+  reference's Node.js merge-tree (no Node runtime exists in this
+  zero-egress image; a V8-JITted B-tree is bounded above by compiled
+  C++ on the same workload, making the factor conservative). The raw
+  Python-oracle comparison is also recorded per stage.
+
+Stages = BASELINE.md configs:
+  config1  SharedString single-doc replay             (BASELINE #1)
+  config2  N docs x concurrent clients, batched apply  (BASELINE #2)
+  config5  service pipeline: sequencer -> sidecar      (BASELINE #5-lite)
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import tempfile
 import time
 
+STAGES = ("config1", "config2", "config5")
 
-def build_workload(docs: int, base_streams: int, steps: int, clients: int):
-    from fluidframework_tpu.ops import build_batch, encode_stream, make_table
+
+# ======================================================================
+# stage implementations (run inside the subprocess)
+
+def _stage_env_setup(backend: str) -> None:
+    """Must run before the first jax import in the stage process. The
+    image's sitecustomize force-selects the axon TPU platform at
+    interpreter start; only a config update overrides it."""
+    if backend == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _build_streams(n_streams: int, steps: int, clients: int, seed0: int):
+    from fluidframework_tpu.ops import encode_stream
     from fluidframework_tpu.testing import FuzzConfig, record_op_stream
 
-    raw_streams = []
-    for i in range(base_streams):
+    raw, encoded = [], []
+    for i in range(n_streams):
         _, stream = record_op_stream(FuzzConfig(
-            n_clients=clients, n_steps=steps, seed=31337 + i,
+            n_clients=clients, n_steps=steps, seed=seed0 + i,
             insert_weight=0.55, remove_weight=0.25, annotate_weight=0.05,
             process_weight=0.15,
         ))
-        raw_streams.append(stream)
-    # Documents are independent; tile the distinct base streams to the
-    # full doc count for throughput measurement.
-    streams = [raw_streams[d % base_streams] for d in range(docs)]
-    encoded = [encode_stream(s) for s in streams]
-    batch = build_batch(encoded)
-    return raw_streams, encoded, batch
+        raw.append(stream)
+        encoded.append(encode_stream(stream))
+    return raw, encoded
 
 
-def bench_kernel(batch, docs: int, capacity: int, reps: int,
-                 cooldown: float = 3.0):
+def _time_kernel(table_fn, batch, reps: int, cooldown: float):
+    """Best-of-reps window time (the tunneled v5e duty-cycle throttles
+    under sustained dispatch; cooldown lets it recover)."""
     import jax
-    import numpy as np
 
-    from fluidframework_tpu.ops import apply_window, make_table
-    from fluidframework_tpu.ops.segment_table import KIND_NOOP
+    from fluidframework_tpu.ops import apply_window
 
-    real_ops = int((np.asarray(batch.kind) != KIND_NOOP).sum())
-    # warmup/compile
-    table = apply_window(make_table(docs, capacity), batch)
-    jax.block_until_ready(table)
-    assert not np.asarray(table.overflow).any(), "bench capacity overflow"
-
-    # The tunneled v5e duty-cycle throttles ~7-50x under sustained
-    # dispatch and needs tens of seconds idle to recover (measured:
-    # 1.7-7 ms/window when cool vs up to 400 ms throttled). Space reps
-    # with a cooldown and report the best observed window.
+    out = apply_window(table_fn(), batch)  # warmup/compile
+    jax.block_until_ready(out)
     times = []
     for _ in range(reps):
-        fresh = make_table(docs, capacity)
+        fresh = table_fn()
         jax.block_until_ready(fresh)
         time.sleep(cooldown)
         t0 = time.perf_counter()
         out = apply_window(fresh, batch)
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
-    best = min(times)
-    return real_ops / best, real_ops, best, times
+    return out, min(times), times
 
 
-def bench_scalar(raw_streams, seconds_budget: float = 3.0):
-    """Scalar client replay ops/sec (host baseline proxy)."""
+def _cpp_baseline(encoded, min_seconds: float = 1.0):
+    """ops/s of the C++ scalar replayer over the distinct streams;
+    None when the toolchain is missing."""
+    from fluidframework_tpu.native.replay_baseline import (
+        encode_ops_array,
+        replay,
+    )
+
+    arrays = [encode_ops_array(e) for e in encoded]
+    probe = replay(arrays[0], reps=1)
+    if probe is None:
+        return None, None
+    # calibrate reps to fill the budget
+    per = max(probe[2], 1e-6)
+    reps = max(1, int(min_seconds / (per * len(arrays))))
+    total_ops = 0
+    total_t = 0.0
+    checksums = []
+    for arr in arrays:
+        checksum, _live, dt = replay(arr, reps=reps)
+        checksums.append(checksum)
+        total_ops += arr.shape[0] * reps
+        total_t += dt
+    return total_ops / total_t, checksums
+
+
+def _py_baseline(raw_streams, seconds: float):
     from fluidframework_tpu.models.mergetree import MergeTreeClient
     from fluidframework_tpu.protocol.messages import MessageType
 
     ops = 0
     t0 = time.perf_counter()
-    while time.perf_counter() - t0 < seconds_budget:
+    while time.perf_counter() - t0 < seconds:
         for stream in raw_streams:
             obs = MergeTreeClient("bench-observer")
             obs.start_collaboration("bench-observer")
@@ -83,55 +132,384 @@ def bench_scalar(raw_streams, seconds_budget: float = 3.0):
                 if msg.type == MessageType.OPERATION:
                     obs.apply_msg(msg)
                     ops += 1
-            if time.perf_counter() - t0 > seconds_budget:
+            if time.perf_counter() - t0 > seconds:
                 break
     return ops / (time.perf_counter() - t0)
 
 
+def _real_ops(batch) -> int:
+    import numpy as np
+
+    from fluidframework_tpu.ops.segment_table import KIND_NOOP
+
+    return int((np.asarray(batch.kind) != KIND_NOOP).sum())
+
+
+def _kernel_stage(name: str, docs: int, base: int, steps: int,
+                  clients: int, capacity: int, seed0: int, reps: int,
+                  cooldown: float) -> dict:
+    """Shared body of the pure-kernel configs: build workload, time the
+    batched dispatch, checksum-verify against the C++ replayer, record
+    both baselines."""
+    from fluidframework_tpu.native.replay_baseline import table_checksum
+    from fluidframework_tpu.ops import build_batch, fetch, make_table
+
+    raw, encoded = _build_streams(base, steps, clients, seed0=seed0)
+    tiled = [encoded[d % base] for d in range(docs)]
+    batch = build_batch(tiled)
+    table, best, times = _time_kernel(
+        lambda: make_table(docs, capacity), batch, reps, cooldown
+    )
+    np_table = fetch(table)
+    assert not np_table["overflow"].any(), f"{name} capacity overflow"
+    real = _real_ops(batch)
+    cpp_ops_s, checksums = _cpp_baseline(encoded)
+    if checksums is not None:
+        for d in range(min(4, docs)):
+            assert checksums[d % base] == table_checksum(np_table, d), (
+                f"{name} kernel/C++ divergence doc {d}"
+            )
+    py_ops_s = _py_baseline(raw, 2.0)
+    return {
+        "docs": docs,
+        "window": int(batch.kind.shape[1]),
+        "kernel_ops_per_sec": round(real / best, 1),
+        "cpp_baseline_ops_per_sec": (
+            round(cpp_ops_s, 1) if cpp_ops_s else None
+        ),
+        "py_baseline_ops_per_sec": round(py_ops_s, 1),
+        "real_ops": real,
+        "best_window_time_s": round(best, 4),
+        "window_times_s": [round(t, 4) for t in times],
+        "parity": "checksum-verified" if checksums else "cpp-unavailable",
+    }
+
+
+def stage_config1(scale: str, reps: int, cooldown: float) -> dict:
+    """BASELINE #1: single-doc replay. One document, long stream —
+    measures per-dispatch latency with no document parallelism (the
+    kernel's worst case; the batch axis is where the win lives)."""
+    steps, capacity = {
+        "full": (1200, 4096), "cpu": (300, 1024), "smoke": (80, 512),
+    }[scale]
+    return _kernel_stage("config1", docs=1, base=1, steps=steps,
+                         clients=2, capacity=capacity, seed0=4242,
+                         reps=reps, cooldown=cooldown)
+
+
+def stage_config2(scale: str, reps: int, cooldown: float) -> dict:
+    """BASELINE #2: N docs x concurrent clients typing, one batched
+    dispatch across all docs — the headline throughput config."""
+    docs, base, steps, clients, capacity = {
+        "full": (1024, 16, 220, 4, 1024),
+        "cpu": (64, 8, 120, 3, 512),
+        "smoke": (16, 4, 60, 3, 512),
+    }[scale]
+    return _kernel_stage("config2", docs=docs, base=base, steps=steps,
+                         clients=clients, capacity=capacity,
+                         seed0=31337, reps=reps, cooldown=cooldown)
+
+
+def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
+    """BASELINE #5-lite: full service pipeline replay — raw client ops
+    re-ticketed through the sequencer (deli), encoded, merged on device
+    via the sidecar. Measures end-to-end service ops/s, not just the
+    kernel."""
+    import dataclasses
+
+    import jax
+
+    from fluidframework_tpu.models.mergetree import MergeTreeClient
+    from fluidframework_tpu.protocol.messages import (
+        ClientDetail,
+        DocumentMessage,
+        MessageType,
+    )
+    from fluidframework_tpu.service import TpuMergeSidecar
+    from fluidframework_tpu.service.sequencer import DocumentSequencer
+
+    docs, base, steps, clients, capacity, apply_every = {
+        "full": (256, 16, 220, 4, 1024, 32),
+        "cpu": (32, 8, 100, 3, 512, 25),
+        "smoke": (8, 4, 40, 2, 256, 20),
+    }[scale]
+    raw, _ = _build_streams(base, steps, clients, seed0=777)
+
+    def corpus(doc):
+        """(client_id, DocumentMessage) replay feed for one doc."""
+        out = []
+        for msg in raw[doc % base]:
+            if msg.type != MessageType.OPERATION:
+                continue
+            out.append((msg.client_id, DocumentMessage(
+                client_sequence_number=msg.client_sequence_number,
+                reference_sequence_number=msg.reference_sequence_number,
+                type=msg.type,
+                contents=msg.contents,
+            )))
+        return out
+
+    sidecar = TpuMergeSidecar(max_docs=docs, capacity=capacity)
+    seqs = []
+    feeds = []
+    client_sets = []
+    for d in range(docs):
+        doc_id = f"doc-{d}"
+        sidecar.track(doc_id, "ds", "ch")
+        seq = DocumentSequencer(doc_id)
+        ids = sorted({cid for cid, _ in corpus(d)})
+        for cid in ids:
+            seq.client_join(ClientDetail(cid))
+        seqs.append(seq)
+        feeds.append(corpus(d))
+        client_sets.append(ids)
+
+    total_real = 0
+    t0 = time.perf_counter()
+    pos = [0] * docs
+    pending = 0
+    done = False
+    while not done:
+        done = True
+        for d in range(docs):
+            feed = feeds[d]
+            if pos[d] >= len(feed):
+                continue
+            done = False
+            for _ in range(apply_every):
+                if pos[d] >= len(feed):
+                    break
+                cid, dmsg = feed[pos[d]]
+                pos[d] += 1
+                res = seqs[d].ticket(cid, dmsg)
+                assert res.ok, res
+                smsg = dataclasses.replace(res.message, contents={
+                    "address": "ds", "channel": "ch",
+                    "contents": dmsg.contents,
+                })
+                sidecar.ingest(f"doc-{d}", smsg)
+                pending += 1
+        if pending:
+            total_real += sidecar.apply()
+            pending = 0
+    jax.block_until_ready(sidecar._table)
+    elapsed = time.perf_counter() - t0
+
+    # scalar-python pipeline baseline: same sequencer work, per-doc
+    # scalar observers instead of the device sidecar
+    n_base_check = min(4, docs)
+    t1 = time.perf_counter()
+    scalar_ops = 0
+    for d in range(min(docs, base)):
+        seq = DocumentSequencer(f"scalar-{d}")
+        ids = client_sets[d]
+        obs = MergeTreeClient("obs")
+        obs.start_collaboration("obs")
+        for cid in ids:
+            seq.client_join(ClientDetail(cid))
+        for cid, dmsg in corpus(d):
+            res = seq.ticket(cid, dmsg)
+            obs.apply_msg(res.message)
+            scalar_ops += 1
+    scalar_elapsed = time.perf_counter() - t1
+    py_pipeline_ops_s = scalar_ops / max(scalar_elapsed, 1e-9)
+
+    # parity: sidecar text vs scalar oracle for a few docs
+    for d in range(n_base_check):
+        obs = MergeTreeClient("obs")
+        obs.start_collaboration("obs")
+        for msg in raw[d % base]:
+            if msg.type == MessageType.OPERATION:
+                obs.apply_msg(msg)
+        assert sidecar.text(f"doc-{d}", "ds", "ch") == obs.get_text(), (
+            f"config5 sidecar/oracle divergence doc {d}"
+        )
+
+    return {
+        "docs": docs,
+        "pipeline_ops_per_sec": round(total_real / elapsed, 1),
+        "kernel_ops_per_sec": round(total_real / elapsed, 1),
+        "py_baseline_ops_per_sec": round(py_pipeline_ops_s, 1),
+        "cpp_baseline_ops_per_sec": None,
+        "real_ops": total_real,
+        "elapsed_s": round(elapsed, 3),
+        "parity": f"text-verified x{n_base_check}",
+    }
+
+
+STAGE_FNS = {
+    "config1": stage_config1,
+    "config2": stage_config2,
+    "config5": stage_config5,
+}
+
+
+def run_stage(name: str, backend: str, scale: str, reps: int,
+              cooldown: float, out_path: str) -> None:
+    _stage_env_setup(backend)
+    import jax
+
+    t0 = time.perf_counter()
+    result = STAGE_FNS[name](scale, reps, cooldown)
+    result.update({
+        "backend": jax.default_backend(),
+        "scale": scale,
+        "stage_elapsed_s": round(time.perf_counter() - t0, 1),
+    })
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+
+
+# ======================================================================
+# parent orchestration (stdlib only — must never touch jax)
+
+def _spawn(stage: str, backend: str, scale: str, reps: int,
+           cooldown: float, timeout: float) -> tuple[dict | None, str]:
+    fd, out_path = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--stage", stage, "--backend", backend, "--scale", scale,
+        "--reps", str(reps), "--cooldown", str(cooldown),
+        "--out", out_path,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            return None, f"rc={proc.returncode}: {proc.stderr[-800:]}"
+        with open(out_path) as f:
+            return json.load(f), ""
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout:.0f}s (backend={backend})"
+    except (OSError, json.JSONDecodeError) as e:
+        return None, f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+
+def orchestrate(smoke: bool, stages: list[str], reps: int,
+                cooldown: float | None, tpu_timeout: float,
+                cpu_timeout: float, total_budget: float) -> dict:
+    """Budget-aware stage runner. TPU attempts stop for later stages
+    once the backend is proven dead (a down tunnel HANGS backend init,
+    so each attempt costs its full timeout) and when the remaining
+    budget couldn't fit a TPU attempt plus the CPU fallback."""
+    t_start = time.monotonic()
+    results: dict[str, dict] = {}
+    failures: dict[str, list[str]] = {}
+    tpu_dead = False
+    tpu_seen_ok = False
+    for stage in stages:
+        attempts: list[str] = []
+        got = None
+        if smoke:
+            plan = [("cpu", "smoke", 1, 0.2, cpu_timeout)]
+        else:
+            cd = cooldown if cooldown is not None else 20.0
+            remaining = total_budget - (time.monotonic() - t_start)
+            plan = []
+            n_tpu = 1 if tpu_seen_ok else 2
+            # admission: the FULL worst-case plan must fit the budget
+            if not tpu_dead and remaining > (
+                n_tpu * tpu_timeout + cpu_timeout
+            ):
+                plan += [("tpu", "full", reps, cd, tpu_timeout)] * n_tpu
+            plan += [("cpu", "cpu", max(1, reps // 2), 0.5, cpu_timeout)]
+        stage_tpu_ok = False
+        for backend, scale, r, cd, tmo in plan:
+            got, err = _spawn(stage, backend, scale, r, cd, tmo)
+            if got is not None:
+                if backend == "tpu":
+                    stage_tpu_ok = tpu_seen_ok = True
+                break
+            attempts.append(f"{backend}/{scale}: {err}")
+        if not smoke and not stage_tpu_ok and not tpu_seen_ok and any(
+            a.startswith("tpu") for a in attempts
+        ):
+            tpu_dead = True  # never came up: stop burning the budget
+        if got is not None:
+            results[stage] = got
+        if attempts:
+            failures[stage] = attempts
+    return {"stages": results, "failures": failures}
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--smoke", action="store_true",
-                        help="small fast run (CI)")
-    parser.add_argument("--docs", type=int, default=None)
-    parser.add_argument("--steps", type=int, default=None)
-    parser.add_argument("--reps", type=int, default=5)
-    parser.add_argument("--cooldown", type=float, default=None,
-                        help="idle seconds between reps (throttle recovery)")
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--stage", choices=STAGES)
+    parser.add_argument("--backend", choices=("tpu", "cpu"),
+                        default="tpu")
+    parser.add_argument("--scale", choices=("full", "cpu", "smoke"),
+                        default="full")
+    parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument("--cooldown", type=float, default=None)
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--stages", default=None,
+                        help="comma list (default: all)")
+    parser.add_argument("--tpu-timeout", type=float, default=420.0)
+    parser.add_argument("--cpu-timeout", type=float, default=420.0)
+    parser.add_argument("--total-budget", type=float, default=2400.0,
+                        help="soft wall-clock budget for all stages")
     args = parser.parse_args()
 
-    if args.smoke:
-        docs, base, steps, clients, capacity = 32, 8, 60, 3, 512
-        cooldown = 0.5
-    else:
-        docs, base, steps, clients, capacity = 1024, 16, 220, 4, 1024
-        cooldown = 35.0
-    docs = args.docs or docs
-    steps = args.steps or steps
-    if args.cooldown is not None:
-        cooldown = args.cooldown
+    if args.stage:  # child mode
+        run_stage(args.stage, args.backend, args.scale, args.reps,
+                  args.cooldown if args.cooldown is not None else 0.5,
+                  args.out)
+        return
 
-    raw_streams, _encoded, batch = build_workload(docs, base, steps, clients)
-    kernel_ops_s, real_ops, best, times = bench_kernel(
-        batch, docs, capacity, args.reps, cooldown
+    stages = (args.stages.split(",") if args.stages else list(STAGES))
+    detail = orchestrate(args.smoke, stages, args.reps, args.cooldown,
+                         args.tpu_timeout, args.cpu_timeout,
+                         args.total_budget)
+
+    primary = detail["stages"].get("config2") or next(
+        iter(detail["stages"].values()), None
     )
-    scalar_ops_s = bench_scalar(raw_streams, 2.0 if args.smoke else 4.0)
+    if primary is None:
+        print(json.dumps({
+            "metric": "mergetree_batched_ops_per_sec",
+            "value": 0,
+            "unit": "ops/s",
+            "vs_baseline": 0,
+            "detail": {
+                "error": "all stages failed",
+                **detail,
+            },
+        }))
+        return
 
-    result = {
+    value = primary["kernel_ops_per_sec"]
+    cpp = primary.get("cpp_baseline_ops_per_sec")
+    py = primary.get("py_baseline_ops_per_sec")
+    if cpp:
+        vs = value / cpp
+        baseline_kind = (
+            "C++ -O2 scalar replay, same semantics/host (proxy for the "
+            "reference's Node.js merge-tree; no Node in this image — "
+            "V8 is bounded above by compiled C++ here, so this factor "
+            "is conservative)"
+        )
+    else:
+        vs = value / py if py else 0
+        baseline_kind = "in-repo scalar Python replay (C++ unavailable)"
+    print(json.dumps({
         "metric": "mergetree_batched_ops_per_sec",
-        "value": round(kernel_ops_s, 1),
+        "value": round(value, 1),
         "unit": "ops/s",
-        "vs_baseline": round(kernel_ops_s / scalar_ops_s, 2),
+        "vs_baseline": round(vs, 2),
         "detail": {
-            "docs": docs,
-            "window": int(batch.kind.shape[1]),
-            "real_ops": real_ops,
-            "best_window_time_s": round(best, 4),
-            "window_times_s": [round(t, 4) for t in times],
-            "scalar_client_ops_per_sec": round(scalar_ops_s, 1),
-            "baseline_proxy": "in-repo scalar Python client replay",
+            "baseline": baseline_kind,
+            **detail,
         },
-    }
-    print(json.dumps(result))
+    }))
 
 
 if __name__ == "__main__":

@@ -24,21 +24,29 @@ _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 
 
-def _build() -> Optional[Path]:
-    global _build_error
-    if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
-        return _LIB
+def _compile(src: Path, out: Path) -> Optional[str]:
+    """g++ -O2 build with mtime caching; returns an error string or
+    None on success."""
+    if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
+        return None
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-        str(_SRC), "-o", str(_LIB),
+        str(src), "-o", str(out),
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True)
     except FileNotFoundError:
-        _build_error = "g++ not found"
-        return None
+        return "g++ not found"
     if proc.returncode != 0:
-        _build_error = proc.stderr[-2000:]
+        return proc.stderr[-2000:]
+    return None
+
+
+def _build() -> Optional[Path]:
+    global _build_error
+    err = _compile(_SRC, _LIB)
+    if err is not None:
+        _build_error = err
         return None
     return _LIB
 
@@ -89,10 +97,48 @@ def native_build_error() -> Optional[str]:
     return _build_error
 
 
+_REPLAY_SRC = _HERE / "merge_replay.cpp"
+_REPLAY_LIB = _HERE / "_merge_replay.so"
+_replay_lib: Optional[ctypes.CDLL] = None
+_replay_error: Optional[str] = None
+
+
+def load_merge_replay() -> Optional[ctypes.CDLL]:
+    """Build + load the C++ scalar merge replayer (the compiled
+    baseline for bench.py); None when the toolchain is unavailable."""
+    global _replay_lib, _replay_error
+    with _lock:
+        if _replay_lib is not None:
+            return _replay_lib
+        if _replay_error is not None:
+            return None
+        if os.environ.get("FFTPU_DISABLE_NATIVE") == "1":
+            return None
+        err = _compile(_REPLAY_SRC, _REPLAY_LIB)
+        if err is not None:
+            _replay_error = err
+            return None
+        lib = ctypes.CDLL(str(_REPLAY_LIB))
+        i64 = ctypes.c_int64
+        lib.merge_replay.restype = None
+        lib.merge_replay.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), i64, i64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(i64),
+        ]
+        _replay_lib = lib
+        return _replay_lib
+
+
+def merge_replay_error() -> Optional[str]:
+    return _replay_error
+
+
 from .sequencer_core import NativeSequencerCore  # noqa: E402
 
 __all__ = [
     "NativeSequencerCore",
     "load_native_sequencer",
+    "load_merge_replay",
+    "merge_replay_error",
     "native_build_error",
 ]

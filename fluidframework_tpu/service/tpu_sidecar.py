@@ -124,7 +124,15 @@ class TpuMergeSidecar:
         if not self._queued or self.queued_ops == 0:
             return 0
         docs = self.max_docs
+        # Pad the window to a power-of-two bucket: ``apply_window`` is
+        # compiled per (docs, window) shape, and an exact-fit window
+        # would recompile on nearly every flush (20-40s each on the
+        # real chip). Pow2 bucketing bounds the shape count to log(n).
         window = max(len(q) for q in self._queued)
+        bucket = 16
+        while bucket < window:
+            bucket *= 2
+        window = bucket
         arrays = {f: np.zeros((docs, window), np.int32)
                   for f in OP_FIELDS}
         arrays["kind"][:] = KIND_NOOP
