@@ -30,6 +30,7 @@ from ..protocol.messages import (
 from ..protocol.quorum import ProtocolOpHandler
 from ..runtime import ChannelRegistry, ContainerRuntime
 from ..utils.events import EventEmitter
+from .collab_window import CollabWindowTracker
 from .scheduler import DeltaScheduler, ScheduleManager
 
 
@@ -74,6 +75,15 @@ class Container(EventEmitter):
         self.inbound_paused = False
         self._enqueued_seq = 0
         self._reconnect_on_nack = False
+        # msn heartbeats for idle clients (collabWindowTracker.ts);
+        # noopCountFrequency=0 disables count-based heartbeats
+        noop_every = self.mc.config.get_number("noopCountFrequency")
+        self.collab_window = CollabWindowTracker(
+            self._submit_noop,
+            max_unacked_ops=(
+                int(noop_every) if noop_every is not None else 50
+            ),
+        )
 
     # ------------------------------------------------------------------
     # load (container.ts load path, §3.3)
@@ -242,6 +252,17 @@ class Container(EventEmitter):
             elif msg.type == MessageType.SUMMARY_NACK:
                 self.emit("summaryNack", msg.contents)
         self.emit("processed", msg)
+        # Heartbeat AFTER dispatch: a write client that only reads must
+        # still advance the service-side msn or zamboni stalls globally.
+        # Only other clients' RUNTIME ops count — feeding noops/joins
+        # back into the tracker would let heartbeats trigger heartbeats
+        # (the acknowledgement cycle collabWindowTracker.ts avoids).
+        if (
+            self.connected
+            and msg.type == MessageType.OPERATION
+            and msg.client_id != self.client_id
+        ):
+            self.collab_window.on_op_processed(msg.sequence_number)
 
     def _on_nack(self, nack: Nack) -> None:
         """A nack means the service dropped our op: the pending queue
@@ -265,12 +286,26 @@ class Container(EventEmitter):
             return  # stays pending; replayed on reconnect
         self._csn += 1
         self._sent_times[self._csn] = time.monotonic()
+        self.collab_window.on_op_sent(self.last_processed_seq)
         self._connection.submit(DocumentMessage(
             client_sequence_number=self._csn,
             reference_sequence_number=self.last_processed_seq,
             type=MessageType.OPERATION,
             contents=contents,
             metadata=metadata,
+        ))
+
+    def _submit_noop(self) -> None:
+        """msn heartbeat (MessageType.NO_OP): carries only our refSeq
+        so the sequencer advances this client's contribution to the
+        msn. No runtime content, no latency tracking."""
+        if not self.connected:
+            return
+        self._csn += 1
+        self._connection.submit(DocumentMessage(
+            client_sequence_number=self._csn,
+            reference_sequence_number=self.last_processed_seq,
+            type=MessageType.NO_OP,
         ))
 
     def flush(self) -> None:
