@@ -93,13 +93,28 @@ class DocStream:
         if op.type == DeltaType.INSERT:
             is_marker = op.text is None
             payload = "" if is_marker else op.text
+            length = 1 if is_marker else len(payload)
             self.ops.append(dict(
                 base, kind=KIND_INSERT, pos1=op.pos1,
                 op_id=len(self.payloads),
-                length=1 if is_marker else len(payload),
+                length=length,
                 is_marker=int(is_marker),
             ))
             self.payloads.append(payload)
+            # Insert-time properties (insert(..., props=) /
+            # segmentPropertiesManager.ts:29): lower to synthetic
+            # ANNOTATEs at the same (seq, refseq, client) covering the
+            # new content — in the sender's view it occupies exactly
+            # [pos1, pos1+length), and sequenced-order LWW then matches
+            # the oracle (later annotates still override).
+            for key, value in (getattr(op, "props", None) or {}).items():
+                if value is None:
+                    continue  # deleting an unset key is a no-op
+                k, v = self.intern_prop(key, value)
+                self.ops.append(dict(
+                    base, kind=KIND_ANNOTATE, pos1=op.pos1,
+                    pos2=op.pos1 + length, prop_key=k, prop_val=v,
+                ))
         elif op.type == DeltaType.REMOVE:
             self.ops.append(dict(
                 base, kind=KIND_REMOVE, pos1=op.pos1, pos2=op.pos2,
@@ -161,6 +176,38 @@ def extract_text(table_np: dict[str, np.ndarray], stream: DocStream,
         length = int(table_np["length"][doc, i])
         parts.append(stream.payloads[op_id][off:off + length])
     return "".join(parts)
+
+
+def interned_signature(client, enc: DocStream) -> tuple:
+    """Per-position (char|"M", interned-props) signature of a scalar
+    ``MergeTreeClient``'s tip view, interning props through ``enc``'s
+    tables so it compares equal to ``extract_signature`` of the device
+    table fed from the same encoder. Unseen VALUES are interned at read
+    time (the value table is unbounded); keys beyond ``PROP_CHANNELS``
+    are inexpressible on device and are skipped on both sides."""
+    tree = client.mergetree
+    out = []
+    for seg in tree.segments:
+        length = tree._length_at(
+            seg, tree.collab.current_seq, tree.collab.client_id
+        )
+        if not length:
+            continue
+        props = [0] * PROP_CHANNELS
+        for key, value in (seg.props or {}).items():
+            if value is None:
+                continue
+            try:
+                k, v = enc.intern_prop(key, value)
+            except ValueError:
+                continue  # key channel overflow: dropped device-side too
+            props[k] = v
+        entry = tuple(props)
+        if seg.is_marker:
+            out.append(("M", entry))
+        else:
+            out.extend((ch, entry) for ch in seg.text)
+    return tuple(out)
 
 
 def extract_signature(table_np: dict[str, np.ndarray], stream: DocStream,

@@ -26,6 +26,9 @@ class FuzzConfig:
     process_weight: float = 0.15
     max_insert_len: int = 8
     seed: int = 0
+    # probability an insert carries initial properties
+    # (insert(..., props=) — segmentPropertiesManager.ts:29)
+    insert_props_weight: float = 0.0
 
 
 def random_op(rng: random.Random, session: MockCollabSession,
@@ -47,7 +50,13 @@ def random_op(rng: random.Random, session: MockCollabSession,
             rng.choices(string.ascii_lowercase,
                         k=rng.randint(1, cfg.max_insert_len))
         )
-        session.do(client_id, "insert_text_local", pos, text)
+        if rng.random() < cfg.insert_props_weight:
+            key = rng.choice(["bold", "color", "size"])
+            value = rng.choice([1, 2, "x"])
+            session.do(client_id, "insert_text_local", pos, text,
+                       {key: value})
+        else:
+            session.do(client_id, "insert_text_local", pos, text)
     elif kind == "remove":
         start = rng.randint(0, length - 1)
         end = rng.randint(start + 1, length)

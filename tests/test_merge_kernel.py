@@ -35,24 +35,9 @@ def oracle_replay(stream):
 def oracle_signature(obs, enc):
     """Observer's visible content with properties interned the same way
     the encoder interned them for the kernel."""
-    tree = obs.mergetree
-    out = []
-    for seg in tree.segments:
-        length = tree._length_at(
-            seg, tree.collab.current_seq, tree.collab.client_id
-        )
-        if not length:
-            continue
-        props = [0] * 4
-        for key, value in (seg.props or {}).items():
-            if key in enc.prop_keys and value is not None:
-                props[enc.prop_keys[key]] = enc.prop_vals[value]
-        props = tuple(props)
-        if seg.is_marker:
-            out.append(("M", props))
-        else:
-            out.extend((ch, props) for ch in seg.text)
-    return tuple(out)
+    from fluidframework_tpu.ops.host_bridge import interned_signature
+
+    return interned_signature(obs, enc)
 
 
 def run_kernel(streams, capacity=512):
