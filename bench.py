@@ -26,6 +26,7 @@ zero evidence):
 Stages = BASELINE.md configs:
   config1  SharedString single-doc replay             (BASELINE #1)
   config2  N docs x concurrent clients, batched apply  (BASELINE #2)
+  config4  SharedTree rebase over N trees              (BASELINE #4)
   config5  service pipeline: sequencer -> sidecar      (BASELINE #5-lite)
 """
 from __future__ import annotations
@@ -38,7 +39,7 @@ import sys
 import tempfile
 import time
 
-STAGES = ("config1", "config2", "config5")
+STAGES = ("config1", "config2", "config4", "config5")
 
 
 # ======================================================================
@@ -210,6 +211,104 @@ def stage_config2(scale: str, reps: int, cooldown: float) -> dict:
                          seed0=31337, reps=reps, cooldown=cooldown)
 
 
+def stage_config4(scale: str, reps: int, cooldown: float) -> dict:
+    """BASELINE #4: SharedTree concurrent rebase over N trees — each
+    tree rebases one peer changeset over a K-deep trunk suffix in a
+    single batched dispatch (the EditManager sequenced path's hot
+    loop)."""
+    import random
+
+    import jax
+    import numpy as np
+
+    from fluidframework_tpu.models.tree import changeset as cs
+    from fluidframework_tpu.ops.tree_atoms import (
+        TreeAtoms,
+        apply_atoms,
+        encode_changeset,
+        stack_changesets,
+    )
+    from fluidframework_tpu.ops.tree_kernel import rebase_over_trunk
+    from fluidframework_tpu.testing.tree_fuzz import (
+        random_changeset,
+        random_trunk,
+    )
+
+    docs, k_trunk, base_n, edits = {
+        "full": (4096, 8, 24, 5),
+        "cpu": (512, 8, 24, 5),
+        "smoke": (64, 4, 12, 3),
+    }[scale]
+    rng = random.Random(2024)
+
+    base = [{"type": "n", "value": i} for i in range(base_n)]
+    cases = []
+    for _ in range(docs):
+        c_marks = random_changeset(rng, base_n, edits)
+        overs, cur = random_trunk(rng, base, k_trunk, edits)
+        cases.append((c_marks, overs, cur))
+
+    c_stack = stack_changesets(
+        [encode_changeset(c)[0] for c, _, _ in cases])
+    trunk = TreeAtoms(*[
+        np.stack([
+            np.stack([encode_changeset(o)[0][f] for o in overs])
+            for _, overs, _ in cases
+        ])
+        for f in ("kind", "pos", "n", "muted")
+    ])
+
+    out = rebase_over_trunk(c_stack, trunk)  # warmup/compile
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        time.sleep(cooldown)
+        t0 = time.perf_counter()
+        out = rebase_over_trunk(c_stack, trunk)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    rebases = docs * k_trunk
+    kernel_ops_s = rebases / best
+
+    # parity: applied-state equality on sample docs
+    for d in range(min(4, docs)):
+        c_marks, overs, cur = cases[d]
+        change = {"root": c_marks}
+        for o in overs:
+            change = cs.rebase(change, {"root": o})
+        expect = cs.walk_apply(cur, change.get("root", []))
+        out_np = {f: np.asarray(getattr(out, f))[d]
+                  for f in out._fields}
+        content = encode_changeset(c_marks)[1]
+        assert apply_atoms(cur, out_np, content) == expect, (
+            f"config4 kernel/scalar divergence doc {d}"
+        )
+
+    # scalar python baseline on a sample
+    sample = cases[:min(64, docs)]
+    t0 = time.perf_counter()
+    for c_marks, overs, _ in sample:
+        change = {"root": c_marks}
+        for o in overs:
+            change = cs.rebase(change, {"root": o})
+    scalar_t = time.perf_counter() - t0
+    py_ops_s = len(sample) * k_trunk / scalar_t
+
+    return {
+        "docs": docs,
+        "trunk_depth": k_trunk,
+        "kernel_ops_per_sec": round(kernel_ops_s, 1),
+        "cpp_baseline_ops_per_sec": None,
+        "py_baseline_ops_per_sec": round(py_ops_s, 1),
+        "real_ops": rebases,
+        "best_window_time_s": round(best, 4),
+        "window_times_s": [round(t, 4) for t in times],
+        "parity": "applied-state-verified x4",
+        "unit": "rebases/s",
+    }
+
+
 def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
     """BASELINE #5-lite: full service pipeline replay — raw client ops
     re-ticketed through the sequencer (deli), encoded, merged on device
@@ -340,6 +439,7 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
 STAGE_FNS = {
     "config1": stage_config1,
     "config2": stage_config2,
+    "config4": stage_config4,
     "config5": stage_config5,
 }
 

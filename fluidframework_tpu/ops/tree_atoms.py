@@ -1,0 +1,148 @@
+"""Tensor form of SharedTree sequence-field changesets.
+
+The TPU redesign of the reference's mark-list rebase
+(packages/dds/tree/src/feature-libraries/sequence-field/rebase.ts:44,
+core/rebase/rebaser.ts:138-170): a changeset becomes a fixed-width
+array of ATOMS, every one expressed in the changeset's INPUT
+coordinates (the mark-list invariant), so rebasing reduces to masked
+position arithmetic — pairwise comparisons and row sums, no pointer
+walk, no data-dependent control flow. Splits can never happen because
+node-targeting marks are unit-granular by construction: a ``del n`` is
+n single-node atoms, each of which independently shifts or mutes.
+
+Atom kinds:
+  NOP   padding
+  INS   attach ``n`` nodes before input position ``pos`` (content
+        stays host-side, keyed by the atom index — same payload rule
+        as the merge kernel)
+  DEL   detach the single node at ``pos``
+  SET   value-set on the single node at ``pos``
+``muted`` marks atoms whose target a rebase-over deleted (the scalar
+algebra's tombstones); they ride along as zero-length anchors.
+
+Device-inexpressible marks (rev/tomb inputs, nested ``fields``) raise
+``ValueError`` — callers fall back to the scalar path, the same
+eviction discipline the merge sidecar uses.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import numpy as np
+
+ATOM_NOP = 0
+ATOM_INS = 1
+ATOM_DEL = 2
+ATOM_SET = 3
+
+DEFAULT_ATOMS = 64
+
+
+class TreeAtoms(NamedTuple):
+    """Batched changeset tensors, all [docs, atoms] int32."""
+
+    kind: Any
+    pos: Any
+    n: Any      # INS width; DEL/SET are unit
+    muted: Any
+
+    @property
+    def atoms(self) -> int:
+        return self.kind.shape[-1]
+
+
+def encode_changeset(marks: list, width: int = DEFAULT_ATOMS
+                     ) -> tuple[dict, list]:
+    """Mark list (one field) -> single-doc atom arrays + host content
+    table (content[i] set for INS atoms, None otherwise)."""
+    kind = np.zeros(width, np.int32)
+    pos = np.zeros(width, np.int32)
+    n = np.zeros(width, np.int32)
+    muted = np.zeros(width, np.int32)
+    content: list = [None] * width
+    a = 0
+    p = 0
+
+    def put(k, at, cnt, payload=None, mute=0):
+        nonlocal a
+        if a >= width:
+            raise ValueError(f"changeset exceeds {width} atoms")
+        kind[a], pos[a], n[a], muted[a] = k, at, cnt, mute
+        content[a] = payload
+        a += 1
+
+    for m in marks:
+        t = m["t"]
+        if t == "skip":
+            p += m["n"]
+        elif t == "ins":
+            put(ATOM_INS, p, len(m["content"]), list(m["content"]))
+        elif t == "del":
+            for i in range(m["n"]):
+                put(ATOM_DEL, p + i, 1)
+            p += m["n"]
+        elif t == "mod":
+            if m.get("fields"):
+                raise ValueError("nested field changes: host path only")
+            if m.get("value") is not None:
+                put(ATOM_SET, p, 1, m["value"])
+            # a valueless, fieldless mod is skip(1) (cs.normalize)
+            p += 1
+        else:  # rev / tomb: repair-store machinery stays host-side
+            raise ValueError(f"device-inexpressible mark {t!r}")
+    return (
+        {"kind": kind, "pos": pos, "n": n, "muted": muted},
+        content,
+    )
+
+
+def stack_changesets(encoded: list[dict]) -> TreeAtoms:
+    """List of single-doc atom dicts -> [docs, atoms] TreeAtoms."""
+    return TreeAtoms(
+        kind=np.stack([e["kind"] for e in encoded]),
+        pos=np.stack([e["pos"] for e in encoded]),
+        n=np.stack([e["n"] for e in encoded]),
+        muted=np.stack([e["muted"] for e in encoded]),
+    )
+
+
+def atoms_to_marks(atoms_np: dict, content: list) -> list:
+    """Decode one doc's (rebased) atoms back into a normalized mark
+    list in the post-rebase input coordinates. Muted atoms drop (their
+    effect is nil; unmuting via revive is host-path work)."""
+    rows = []
+    for i in range(len(atoms_np["kind"])):
+        k = int(atoms_np["kind"][i])
+        if k == ATOM_NOP or int(atoms_np["muted"][i]):
+            continue
+        rows.append((int(atoms_np["pos"][i]), k != ATOM_INS, i, k))
+    rows.sort(key=lambda r: (r[0], r[1], r[2]))
+    marks: list = []
+    cursor = 0
+    for at, _node_op, i, k in rows:
+        if at > cursor:
+            marks.append({"t": "skip", "n": at - cursor})
+            cursor = at
+        if k == ATOM_INS:
+            marks.append({"t": "ins",
+                          "content": list(content[i] or [])})
+        elif k == ATOM_DEL:
+            if (marks and marks[-1]["t"] == "del"):
+                marks[-1]["n"] += 1
+            else:
+                marks.append({"t": "del", "n": 1})
+            cursor += 1
+        else:  # SET
+            value = content[i]
+            marks.append({"t": "mod", "value": value})
+            cursor += 1
+    return marks
+
+
+def apply_atoms(seq: list, atoms_np: dict, content: list) -> list:
+    """Apply one doc's atoms to a node list (positions are input
+    coordinates of ``seq``) — the host applier for parity checks and
+    forest updates."""
+    from ..models.tree.changeset import walk_apply
+
+    return walk_apply(seq, atoms_to_marks(atoms_np, content))

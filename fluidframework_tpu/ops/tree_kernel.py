@@ -1,0 +1,97 @@
+"""Batched SharedTree rebase kernel.
+
+Vectorized form of the scalar mark-list rebase
+(models/tree/changeset.py:_rebase_marks; reference semantics:
+packages/dds/tree/src/feature-libraries/sequence-field/rebase.ts:44
+under the ChangeRebaser laws of core/rebase/rebaser.ts:138-170).
+
+Because every atom of a changeset is expressed in the changeset's
+input coordinates (tree_atoms.py), ``rebase(C, over=O)`` for the
+sequenced path is pure position arithmetic per C-atom:
+
+  ins_shift  = sum of O-insert widths that land at-or-before the
+               atom's node (strictly-before for C attaches: the
+               later-sequenced change keeps the left slot — the
+               merge-tree breakTie convention, mergeTree.ts:1705)
+  del_shift  = number of O-deleted nodes strictly before the atom
+  muted      = O deleted the atom's target node
+
+  pos' = pos + ins_shift - del_shift
+
+All pairwise [A, A] masks + row sums — dense, branch-free, ideal XLA.
+Rebasing over a trunk SUFFIX of K changesets is a ``lax.scan`` over K
+(the ChangeRebaser law ``rebase(a, compose(b, c)) ==
+rebase(rebase(a, b), c)`` makes the sequential form exact), vmapped
+over the doc axis — same doc-parallel shape as the merge kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tree_atoms import ATOM_DEL, ATOM_INS, ATOM_SET, TreeAtoms
+
+
+def _rebase_one(c: TreeAtoms, o: TreeAtoms) -> TreeAtoms:
+    """Rebase one doc's changeset atoms over one doc's ``over`` atoms
+    (shared input coordinates)."""
+    live_o = o.muted == 0
+    o_ins = (o.kind == ATOM_INS) & live_o
+    o_del = (o.kind == ATOM_DEL) & live_o
+
+    cpos = c.pos[:, None]          # [A, 1]
+    opos = o.pos[None, :]          # [1, A]
+    node_target = ((c.kind == ATOM_DEL) | (c.kind == ATOM_SET)) & (
+        c.muted == 0
+    )
+
+    # O-insert widths shifting each C atom. Node targets shift when the
+    # insert lands at-or-before their node (an insert AT index p pushes
+    # node p right); attaches/anchors only for strictly-before (tied
+    # position: later-sequenced C keeps the left slot).
+    at_or_before = opos <= cpos
+    strictly_before = opos < cpos
+    ins_applies = jnp.where(
+        node_target[:, None], at_or_before, strictly_before
+    ) & o_ins[None, :]
+    ins_shift = jnp.sum(
+        jnp.where(ins_applies, o.n[None, :], 0), axis=1
+    )
+
+    # O unit-deletes strictly before each atom collapse positions left.
+    del_shift = jnp.sum(
+        (o_del[None, :] & strictly_before).astype(jnp.int32), axis=1
+    )
+
+    # target node deleted by O -> mute (the scalar algebra's tombstone)
+    hit = jnp.any(o_del[None, :] & (opos == cpos), axis=1)
+    muted = jnp.where(node_target & hit, 1, c.muted)
+
+    pos = jnp.where(
+        c.kind == 0, c.pos, c.pos + ins_shift - del_shift
+    )
+    return TreeAtoms(kind=c.kind, pos=pos, n=c.n, muted=muted)
+
+
+def rebase_atoms_impl(c: TreeAtoms, o: TreeAtoms) -> TreeAtoms:
+    """[docs, A] x [docs, A] batched rebase (one over step)."""
+    return jax.vmap(_rebase_one)(c, o)
+
+
+rebase_atoms = jax.jit(rebase_atoms_impl)
+
+
+def rebase_over_trunk_impl(c: TreeAtoms, trunk: TreeAtoms) -> TreeAtoms:
+    """Rebase each doc's changeset over its trunk suffix: ``trunk``
+    arrays are [docs, K, A]; the K axis scans sequentially (exact by
+    the compose law), docs in parallel."""
+    trunk_kd = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), trunk)
+
+    def step(cur, over):
+        return rebase_atoms_impl(cur, over), None
+
+    out, _ = jax.lax.scan(step, c, trunk_kd)
+    return out
+
+
+rebase_over_trunk = jax.jit(rebase_over_trunk_impl)

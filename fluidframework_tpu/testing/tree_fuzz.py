@@ -1,0 +1,53 @@
+"""Random changeset generation for the tree rebase algebra — shared by
+the kernel differential tests and bench config #4 so the parity
+workload and the benchmark workload can't drift apart.
+
+Mirrors the reference's rebase-law fuzz pattern
+(packages/dds/tree/src/test/rebase/generateFuzzyCombinedChange.spec.ts).
+"""
+from __future__ import annotations
+
+import random
+
+from ..models.tree import changeset as cs
+
+
+def random_changeset(rng: random.Random, base_len: int,
+                     n_edits: int = 3) -> list:
+    """Random ins/del/mod mark list against a base of ``base_len``
+    nodes — the device-expressible subset (tree_atoms.py)."""
+    marks = []
+    remaining = base_len
+    for _ in range(n_edits):
+        if remaining <= 0:
+            break
+        gap = rng.randint(0, max(0, remaining - 1))
+        if gap:
+            marks.append(cs.skip(gap))
+            remaining -= gap
+        choice = rng.random()
+        if choice < 0.4:
+            marks.append(cs.ins(
+                [{"type": "n", "value": rng.randint(0, 99)}
+                 for _ in range(rng.randint(1, 3))]
+            ))
+        elif choice < 0.75 and remaining > 0:
+            k = rng.randint(1, min(3, remaining))
+            marks.append(cs.dele(k))
+            remaining -= k
+        elif remaining > 0:
+            marks.append(cs.mod(value={"new": rng.randint(100, 199)}))
+            remaining -= 1
+    return cs.normalize(marks)
+
+
+def random_trunk(rng: random.Random, base: list, depth: int,
+                 n_edits: int = 3) -> tuple[list[list], list]:
+    """``depth`` successive changesets, each authored against the
+    previous one's output; returns (changesets, final_sequence)."""
+    overs, cur = [], list(base)
+    for _ in range(depth):
+        o = random_changeset(rng, len(cur), n_edits)
+        overs.append(o)
+        cur = cs.walk_apply(cur, o)
+    return overs, cur
