@@ -26,6 +26,7 @@ zero evidence):
 Stages = BASELINE.md configs:
   config1  SharedString single-doc replay             (BASELINE #1)
   config2  N docs x concurrent clients, batched apply  (BASELINE #2)
+  config3  SharedMatrix N-matrix spreadsheet           (BASELINE #3)
   config4  SharedTree rebase over N trees              (BASELINE #4)
   config5  service pipeline: sequencer -> sidecar      (BASELINE #5-lite)
 """
@@ -39,7 +40,7 @@ import sys
 import tempfile
 import time
 
-STAGES = ("config1", "config2", "config4", "config5")
+STAGES = ("config1", "config2", "config3", "config4", "config5")
 
 
 # ======================================================================
@@ -209,6 +210,158 @@ def stage_config2(scale: str, reps: int, cooldown: float) -> dict:
     return _kernel_stage("config2", docs=docs, base=base, steps=steps,
                          clients=clients, capacity=capacity,
                          seed0=31337, reps=reps, cooldown=cooldown)
+
+
+def stage_config3(scale: str, reps: int, cooldown: float) -> dict:
+    """BASELINE #3: N-matrix spreadsheet workload — 10k-row scale on
+    the full config. Axis ops (row/col insert+remove runs) run through
+    the merge kernel as a single 2N-doc dispatch; cell sets apply as
+    one vectorized host scatter. The op stream here is sequentially
+    consistent (refseq = seq-1) — concurrency semantics are covered by
+    the kernel fuzz suites; this stage measures scale."""
+    import jax
+
+    from fluidframework_tpu.models.mergetree.ops import (
+        InsertOp,
+        RemoveOp,
+    )
+    from fluidframework_tpu.ops import fetch
+    from fluidframework_tpu.ops.matrix_bridge import (
+        MatrixStream,
+        apply_matrix_batch,
+        extract_matrix,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    matrices, row_runs, run_len, cols, cells, removes, capacity = {
+        "full": (64, 205, 50, 16, 4000, 60, 1024),
+        "cpu": (8, 40, 25, 8, 800, 20, 256),
+        "smoke": (2, 10, 10, 4, 100, 5, 128),
+    }[scale]
+    import random
+
+    rng = random.Random(1337)
+
+    def build_stream(m):
+        ms = MatrixStream()
+        seq = 0
+        alloc = 0
+
+        def send(contents):
+            nonlocal seq
+            seq += 1
+            ms.add_message(SequencedMessage(
+                client_id="w", sequence_number=seq,
+                minimum_sequence_number=max(0, seq - 1),
+                client_sequence_number=seq,
+                reference_sequence_number=seq - 1,
+                type=MessageType.OPERATION, contents=contents,
+            ))
+
+        n_rows = 0
+        for r in range(row_runs):
+            send({"target": "rows", "op": InsertOp(
+                pos1=rng.randint(0, n_rows),
+                text="\x00" * run_len,
+                handle=[f"w/{m}/{alloc}", 0],
+            )})
+            alloc += 1
+            n_rows += run_len
+        for c in range(cols):
+            send({"target": "cols", "op": InsertOp(
+                pos1=rng.randint(0, c), text="\x00",
+                handle=[f"w/{m}/c{c}", 0],
+            )})
+        for _ in range(removes):
+            start = rng.randint(0, n_rows - 2)
+            send({"target": "rows", "op": RemoveOp(
+                pos1=start, pos2=start + 1)})
+            n_rows -= 1
+        for _ in range(cells):
+            send({
+                "target": "cell",
+                "row": f"w/{m}/{rng.randint(0, row_runs - 1)}:"
+                       f"{rng.randint(0, run_len - 1)}",
+                "col": f"w/{m}/c{rng.randint(0, cols - 1)}:0",
+                "value": rng.randint(0, 9999),
+            })
+        return ms
+
+    streams = [build_stream(m) for m in range(matrices)]
+    total_ops = sum(ms.op_count for ms in streams)
+
+    table = apply_matrix_batch(streams, capacity)  # warmup/compile
+    jax.block_until_ready(table)
+    times = []
+    for _ in range(reps):
+        time.sleep(cooldown)
+        t0 = time.perf_counter()
+        table = apply_matrix_batch(streams, capacity)
+        jax.block_until_ready(table)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    np_table = fetch(table)
+    assert not np_table["overflow"].any(), "config3 capacity overflow"
+
+    # host cell materialization (the scatter+gather), one matrix
+    t0 = time.perf_counter()
+    grid = extract_matrix(np_table, streams[0], 0)
+    extract_s = time.perf_counter() - t0
+
+    # scalar python baseline (host replay of both axes + dict cells)
+    from fluidframework_tpu.ops.host_replay import replay_encoded
+
+    t0 = time.perf_counter()
+    sample = streams[: max(1, matrices // 8)]
+    scalar_ops = 0
+    host_rows = host_cols = None
+    for ms in sample:
+        host_rows = replay_encoded(ms.rows.ops)
+        host_cols = replay_encoded(ms.cols.ops)
+        cells_map = {}
+        for rh, ch, v in zip(ms.cell_rows, ms.cell_cols, ms.cell_vals):
+            cells_map[(rh, ch)] = v
+        scalar_ops += ms.op_count
+    py_s = time.perf_counter() - t0
+    py_ops_s = scalar_ops / py_s
+
+    # parity: device axis handle order == host-replay handle order for
+    # the last sampled matrix
+    from fluidframework_tpu.ops.matrix_bridge import _visible_handles
+
+    ms0 = sample[-1]
+    d0 = len(sample) - 1
+    assert _visible_handles(np_table, 2 * d0, ms0.row_allocs) == \
+        _visible_handles(host_rows.as_table(), 0, ms0.row_allocs), (
+            "config3 device/host row-axis divergence")
+    assert _visible_handles(np_table, 2 * d0 + 1, ms0.col_allocs) == \
+        _visible_handles(host_cols.as_table(), 0, ms0.col_allocs), (
+            "config3 device/host col-axis divergence")
+
+    cpp_ops_s, _ = _cpp_baseline(
+        [ms.rows for ms in streams[:8]]
+        + [ms.cols for ms in streams[:8]]
+    )
+
+    kernel_ops_s = total_ops / (best + extract_s * matrices)
+    return {
+        "matrices": matrices,
+        "rows": row_runs * run_len,
+        "kernel_ops_per_sec": round(kernel_ops_s, 1),
+        "device_axis_ops_per_sec": round(total_ops / best, 1),
+        "cpp_baseline_ops_per_sec": (
+            round(cpp_ops_s, 1) if cpp_ops_s else None
+        ),
+        "py_baseline_ops_per_sec": round(py_ops_s, 1),
+        "real_ops": total_ops,
+        "best_window_time_s": round(best, 4),
+        "extract_one_matrix_s": round(extract_s, 4),
+        "window_times_s": [round(t, 4) for t in times],
+        "parity": f"grid {len(grid)}x{len(grid[0]) if grid else 0}",
+    }
 
 
 def stage_config4(scale: str, reps: int, cooldown: float) -> dict:
@@ -439,6 +592,7 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
 STAGE_FNS = {
     "config1": stage_config1,
     "config2": stage_config2,
+    "config3": stage_config3,
     "config4": stage_config4,
     "config5": stage_config5,
 }

@@ -71,6 +71,11 @@ class DocStream:
         self._add_op(msg.contents, msg)
 
     def add_noop(self, min_seq: int) -> None:
+        # NOT coalesced here: the sidecar ships ops incrementally
+        # (stream.ops[before:]), so mutating an already-dispatched noop
+        # in place would silently drop idle-heartbeat min_seq advances
+        # (code-review r2). Consumers coalesce at pack time instead
+        # (build_batch, sidecar._dispatch), where it is safe.
         self.ops.append(dict(
             kind=KIND_NOOP, pos1=0, pos2=0, seq=0, refseq=0, client=0,
             op_id=0, length=0, is_marker=0, prop_key=0, prop_val=0,
@@ -137,20 +142,39 @@ def encode_stream(messages: list[SequencedMessage]) -> DocStream:
     return stream
 
 
+def coalesce_noops(ops: list[dict]) -> list[dict]:
+    """Collapse runs of consecutive noops to one carrying the max
+    min_seq — only the window floor matters, and cell/system-heavy
+    streams would otherwise pad every doc's window. Pack-time only:
+    the source stream stays faithful for incremental consumers."""
+    out: list[dict] = []
+    for op in ops:
+        if (
+            op["kind"] == KIND_NOOP and out
+            and out[-1]["kind"] == KIND_NOOP
+        ):
+            if op["min_seq"] > out[-1]["min_seq"]:
+                out[-1] = dict(out[-1], min_seq=op["min_seq"])
+            continue
+        out.append(op)
+    return out
+
+
 def build_batch(streams: list[DocStream],
                 window: Optional[int] = None) -> OpBatch:
     """Pack per-doc streams into [docs, window] OpBatch arrays, padded
-    with NOOPs."""
-    window = window or max(len(s.ops) for s in streams)
+    with NOOPs (consecutive noops coalesced)."""
+    packed = [coalesce_noops(s.ops) for s in streams]
+    window = window or max(len(p) for p in packed)
     docs = len(streams)
     arrays = {f: np.zeros((docs, window), np.int32) for f in OP_FIELDS}
     arrays["kind"][:] = KIND_NOOP
-    for d, stream in enumerate(streams):
-        if len(stream.ops) > window:
+    for d, ops in enumerate(packed):
+        if len(ops) > window:
             raise ValueError(
-                f"doc {d}: {len(stream.ops)} ops exceed window {window}"
+                f"doc {d}: {len(ops)} ops exceed window {window}"
             )
-        for w, op in enumerate(stream.ops):
+        for w, op in enumerate(ops):
             for f in OP_FIELDS:
                 arrays[f][d, w] = op[f]
     return OpBatch(**arrays)

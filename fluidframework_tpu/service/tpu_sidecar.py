@@ -167,12 +167,17 @@ class TpuMergeSidecar:
         return real
 
     def _dispatch(self) -> int:
+        from ..ops.host_bridge import coalesce_noops
+
         docs = self.max_docs
-        # Pad the window to a power-of-two bucket: ``apply_window`` is
-        # compiled per (docs, window) shape, and an exact-fit window
-        # would recompile on nearly every flush (20-40s each on the
-        # real chip). Pow2 bucketing bounds the shape count to log(n).
-        window = max(len(q) for q in self._queued)
+        # Coalesce noop runs at pack time (safe here: the queue is
+        # consumed whole), then pad the window to a power-of-two
+        # bucket: ``apply_window`` is compiled per (docs, window)
+        # shape, and an exact-fit window would recompile on nearly
+        # every flush (20-40s each on the real chip). Pow2 bucketing
+        # bounds the shape count to log(n).
+        packed = [coalesce_noops(q) for q in self._queued]
+        window = max(len(p) for p in packed)
         bucket = 16
         while bucket < window:
             bucket *= 2
@@ -180,8 +185,10 @@ class TpuMergeSidecar:
                   for f in OP_FIELDS}
         arrays["kind"][:] = KIND_NOOP
         real = 0
-        for slot, queue in enumerate(self._queued):
-            for w, op in enumerate(queue):
+        for slot, (queue, ops) in enumerate(
+            zip(self._queued, packed)
+        ):
+            for w, op in enumerate(ops):
                 for f in OP_FIELDS:
                     arrays[f][slot, w] = op[f]
                 if op["kind"] != KIND_NOOP:

@@ -1,0 +1,169 @@
+"""SharedMatrix batched path vs the scalar model (VERDICT r1 missing
+#5 / BASELINE config #3): two merge-kernel axes in one dispatch +
+vectorized cell scatter must reproduce the converged to_lists() of the
+live SharedMatrix replicas."""
+import dataclasses
+import random
+
+import pytest
+
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.ops import fetch
+from fluidframework_tpu.ops.matrix_bridge import (
+    MatrixStream,
+    apply_matrix_batch,
+    extract_matrix,
+)
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.service import LocalServer
+
+
+def channel_stream(server, document_id, ds_id, ch_id):
+    """Extract one channel's inner sequenced stream from the op log
+    (the sidecar's envelope rule)."""
+    out = []
+    for msg in server.read_ops(document_id, 0):
+        envelope = msg.contents if isinstance(msg.contents, dict) else {}
+        if (
+            msg.type == MessageType.OPERATION
+            and envelope.get("kind", "op") == "op"
+            and envelope.get("address") == ds_id
+            and envelope.get("channel") == ch_id
+        ):
+            out.append(
+                dataclasses.replace(msg, contents=envelope["contents"])
+            )
+        else:
+            out.append(dataclasses.replace(
+                msg, type=MessageType.NO_OP, contents=None,
+                client_id=None,
+            ))
+    return out
+
+
+def make_matrix_session(doc="m"):
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    a = Container.load(factory.create_document_service(doc),
+                       client_id="alice")
+    b = Container.load(factory.create_document_service(doc),
+                       client_id="bob")
+    ma = a.runtime.create_datastore("d").create_channel("sharedmatrix", "m")
+    a.flush()
+    mb = b.runtime.get_datastore("d").get_channel("m")
+    return server, a, b, ma, mb
+
+
+def replay_kernel(server, doc="m"):
+    ms = MatrixStream()
+    for msg in channel_stream(server, doc, "d", "m"):
+        ms.add_message(msg)
+    table = apply_matrix_batch([ms], capacity=512)
+    np_table = fetch(table)
+    assert not np_table["overflow"].any()
+    return extract_matrix(np_table, ms, 0)
+
+
+def test_matrix_kernel_basic():
+    server, a, b, ma, mb = make_matrix_session()
+    ma.insert_rows(0, 3)
+    ma.insert_cols(0, 2)
+    a.flush()
+    ma.set_cell(0, 0, "tl")
+    ma.set_cell(2, 1, "br")
+    a.flush()
+    mb.set_cell(1, 1, "mid")
+    b.flush()
+    assert ma.to_lists() == mb.to_lists()
+    assert replay_kernel(server) == ma.to_lists()
+
+
+def test_matrix_kernel_concurrent_permutation_vs_cells():
+    """Cells commute with concurrent permutation (handle stability)."""
+    server, a, b, ma, mb = make_matrix_session()
+    ma.insert_rows(0, 4)
+    ma.insert_cols(0, 3)
+    a.flush()
+    for r in range(4):
+        for c in range(3):
+            ma.set_cell(r, c, f"{r}.{c}")
+    a.flush()
+    # concurrent: A removes row 1 while B writes into rows 1 and 2
+    ma.remove_rows(1, 1)
+    mb.set_cell(1, 0, "doomed")
+    mb.set_cell(2, 0, "survives")
+    a.flush()
+    b.flush()
+    assert ma.to_lists() == mb.to_lists()
+    assert replay_kernel(server) == ma.to_lists()
+
+
+def test_matrix_kernel_concurrent_row_inserts_tiebreak():
+    server, a, b, ma, mb = make_matrix_session()
+    ma.insert_rows(0, 2)
+    ma.insert_cols(0, 1)
+    a.flush()
+    ma.insert_rows(0, 1)
+    mb.insert_rows(0, 1)
+    ma.set_cell(0, 0, "a-row")
+    mb.set_cell(0, 0, "b-row")
+    a.flush()
+    b.flush()
+    assert ma.to_lists() == mb.to_lists()
+    assert replay_kernel(server) == ma.to_lists()
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_matrix_kernel_fuzz(seed):
+    rng = random.Random(seed * 37 + 11)
+    server, a, b, ma, mb = make_matrix_session()
+    ma.insert_rows(0, 2)
+    ma.insert_cols(0, 2)
+    a.flush()
+    clients = [(a, ma), (b, mb)]
+    for step in range(60):
+        c, m = clients[rng.randint(0, 1)]
+        roll = rng.random()
+        try:
+            if roll < 0.2:
+                m.insert_rows(rng.randint(0, m.row_count), rng.randint(1, 2))
+            elif roll < 0.35:
+                m.insert_cols(rng.randint(0, m.col_count), 1)
+            elif roll < 0.45 and m.row_count > 1:
+                m.remove_rows(rng.randint(0, m.row_count - 1), 1)
+            elif roll < 0.5 and m.col_count > 1:
+                m.remove_cols(rng.randint(0, m.col_count - 1), 1)
+            elif m.row_count and m.col_count:
+                m.set_cell(rng.randint(0, m.row_count - 1),
+                           rng.randint(0, m.col_count - 1),
+                           rng.randint(0, 999))
+        except AssertionError:
+            continue  # cell outside local view mid-churn
+        if rng.random() < 0.5:
+            c.flush()
+    a.flush()
+    b.flush()
+    assert ma.to_lists() == mb.to_lists(), f"seed {seed} diverged"
+    assert replay_kernel(server) == ma.to_lists(), f"seed {seed}"
+
+
+def test_matrix_kernel_reconnect_resubmit_handles():
+    """code-review r2: reconnect resubmission emits GroupOps and split
+    inserts with handle=[alloc, base>0]; the device handle derivation
+    must track both or cells miss after replay."""
+    server, a, b, ma, mb = make_matrix_session()
+    ma.insert_rows(0, 2)
+    ma.insert_cols(0, 2)
+    a.flush()
+    a.disconnect()
+    # offline: a run insert that will be split by b's concurrent edit
+    ma.insert_rows(1, 3)
+    ma.set_cell(2, 0, "offline")
+    mb.insert_rows(0, 1)
+    b.flush()
+    a.connect()
+    a.flush()
+    b.flush()
+    assert ma.to_lists() == mb.to_lists()
+    assert replay_kernel(server) == ma.to_lists()
