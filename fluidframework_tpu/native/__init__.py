@@ -54,7 +54,7 @@ def _build() -> Optional[Path]:
 def load_native_sequencer() -> Optional[ctypes.CDLL]:
     """Build (if needed) + load the native core; None when the
     toolchain is unavailable (callers fall back to Python)."""
-    global _lib
+    global _lib, _build_error
     with _lock:
         if _lib is not None:
             return _lib
@@ -65,7 +65,11 @@ def load_native_sequencer() -> Optional[ctypes.CDLL]:
         path = _build()
         if path is None:
             return None
-        lib = ctypes.CDLL(str(path))
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError as e:  # truncated/wrong-arch cached build
+            _build_error = f"CDLL load failed: {e}"
+            return None
         i64, p_i64 = ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)
         p_i32 = ctypes.POINTER(ctypes.c_int32)
         lib.seq_create.restype = ctypes.c_void_p
@@ -118,7 +122,11 @@ def load_merge_replay() -> Optional[ctypes.CDLL]:
         if err is not None:
             _replay_error = err
             return None
-        lib = ctypes.CDLL(str(_REPLAY_LIB))
+        try:
+            lib = ctypes.CDLL(str(_REPLAY_LIB))
+        except OSError as e:  # truncated/wrong-arch cached build
+            _replay_error = f"CDLL load failed: {e}"
+            return None
         i64 = ctypes.c_int64
         lib.merge_replay.restype = None
         lib.merge_replay.argtypes = [

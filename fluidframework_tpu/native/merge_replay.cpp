@@ -54,7 +54,7 @@ struct Doc {
   bool removal_visible(const Seg& s, int32_t refseq, int32_t client) const {
     return s.removed_seq != kNotRemoved &&
            (s.removed_seq <= refseq ||
-            ((s.removers >> static_cast<uint32_t>(client)) & 1u));
+            ((s.removers >> (static_cast<uint32_t>(client) & 31u)) & 1u));
   }
   bool insert_visible(const Seg& s, int32_t refseq, int32_t client) const {
     return s.seq <= refseq || s.client == client;
@@ -128,7 +128,9 @@ struct Doc {
             client = op[kF_client], kind = op[kF_kind];
     boundary(p1, refseq, client);
     boundary(p2, refseq, client);
-    uint32_t bit = 1u << static_cast<uint32_t>(client);
+    // encode enforces client < 32 (DocStream.intern_client); the
+    // clamp guards against UB if a hand-built stream violates it.
+    uint32_t bit = 1u << (static_cast<uint32_t>(client) & 31u);
     int64_t E = 0;
     for (size_t i = 0; i < segs.size(); ++i) {
       Seg& s = segs[i];

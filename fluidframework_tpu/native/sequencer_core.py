@@ -185,6 +185,42 @@ class NativeSequencerCore:
                 )))
         return results
 
+    def ticket_batch_arrays(self, cids, csns, refs):
+        """The true throughput lane: ticket a whole window with zero
+        per-op Python objects. Inputs are int64 arrays (client ids
+        already interned via ``intern_id``); returns (seq, msn, status)
+        numpy arrays — exactly the numeric form the TPU sidecar's
+        OpBatch wants, so sequencing feeds the device path without ever
+        materializing SequencedMessage objects. Status 0 = sequenced,
+        2 = duplicate (dropped), else nack (resolve via the scalar
+        ``ticket`` path for the message/nack details — cold path)."""
+        import numpy as np
+
+        cids = np.ascontiguousarray(cids, dtype=np.int64)
+        csns = np.ascontiguousarray(csns, dtype=np.int64)
+        refs = np.ascontiguousarray(refs, dtype=np.int64)
+        n = len(cids)
+        out_seq = np.empty(n, np.int64)
+        out_msn = np.empty(n, np.int64)
+        out_status = np.empty(n, np.int32)
+        p64 = ctypes.POINTER(ctypes.c_int64)
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        self._lib.seq_ticket_batch(
+            self._handle, n,
+            cids.ctypes.data_as(p64),
+            csns.ctypes.data_as(p64),
+            refs.ctypes.data_as(p64),
+            out_seq.ctypes.data_as(p64),
+            out_msn.ctypes.data_as(p64),
+            out_status.ctypes.data_as(p32),
+        )
+        return out_seq, out_msn, out_status
+
+    def intern_id(self, client_id: str) -> int:
+        """Public interning hook for the array lane (intern once per
+        client, not per op)."""
+        return self._intern_id(client_id)
+
     def system_message(self, msg_type: MessageType,
                        contents: Any) -> SequencedMessage:
         """Allocate a seq for a service-generated op (summaryAck/Nack

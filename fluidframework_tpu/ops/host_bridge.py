@@ -22,6 +22,7 @@ from .segment_table import (
     KIND_INSERT,
     KIND_NOOP,
     KIND_REMOVE,
+    MAX_CLIENTS,
     NOT_REMOVED,
     OpBatch,
     PROP_CHANNELS,
@@ -46,6 +47,14 @@ class DocStream:
 
     def intern_client(self, long_id: str) -> int:
         if long_id not in self.client_ids:
+            if len(self.client_ids) >= MAX_CLIENTS:
+                # the removers bitmask is MAX_CLIENTS wide; a 33rd
+                # client would shift out of range (UB in the C++ twin).
+                # Raising here routes the doc to the sidecar's host
+                # eviction path, same as property-channel overflow.
+                raise ValueError(
+                    f"more than {MAX_CLIENTS} clients in one document"
+                )
             self.client_ids[long_id] = len(self.client_ids)
         return self.client_ids[long_id]
 
@@ -140,6 +149,80 @@ def encode_stream(messages: list[SequencedMessage]) -> DocStream:
     for msg in messages:
         stream.add_message(msg)
     return stream
+
+
+def decode_stream(stream: DocStream) -> list[SequencedMessage]:
+    """Reconstruct sequenced messages from an encoded stream — the
+    inverse of ``encode_stream`` up to op-level equivalence (GROUP ops
+    come back as groups of their flattened parts; insert-time props come
+    back as a same-seq annotate inside the group, which is LWW-identical
+    in sequenced order; marker refTypes are not round-tripped — the
+    encoding never held them, and text/signature reads don't consume
+    them).
+
+    This makes the encoded stream the single canonical per-doc history:
+    the sidecar's eviction path replays it through the scalar oracle
+    instead of retaining a duplicate raw-message log (advisor r2)."""
+    from ..models.mergetree.ops import (
+        AnnotateOp,
+        GroupOp,
+        InsertOp,
+        RemoveOp,
+    )
+
+    inv_clients = {v: k for k, v in stream.client_ids.items()}
+    inv_keys = {v: k for k, v in stream.prop_keys.items()}
+    inv_vals = {v: k for k, v in stream.prop_vals.items()}
+
+    def decode_op(op: dict):
+        if op["kind"] == KIND_INSERT:
+            if op["is_marker"]:
+                return InsertOp(pos1=op["pos1"], marker={"refType": 0})
+            return InsertOp(
+                pos1=op["pos1"], text=stream.payloads[op["op_id"]]
+            )
+        if op["kind"] == KIND_REMOVE:
+            return RemoveOp(pos1=op["pos1"], pos2=op["pos2"])
+        key = inv_keys[op["prop_key"]]
+        val = None if op["prop_val"] == 0 else inv_vals[op["prop_val"]]
+        return AnnotateOp(pos1=op["pos1"], pos2=op["pos2"],
+                          props={key: val})
+
+    out: list[SequencedMessage] = []
+    i = 0
+    while i < len(stream.ops):
+        op = stream.ops[i]
+        if op["kind"] == KIND_NOOP:
+            out.append(SequencedMessage(
+                client_id=None, sequence_number=0,
+                minimum_sequence_number=op["min_seq"],
+                client_sequence_number=0, reference_sequence_number=0,
+                type=MessageType.NO_OP, contents=None,
+            ))
+            i += 1
+            continue
+        # fold the flattened run sharing one (seq, client) back into
+        # a single sequenced message (GROUP / insert-time props)
+        j = i + 1
+        while (
+            j < len(stream.ops)
+            and stream.ops[j]["kind"] != KIND_NOOP
+            and stream.ops[j]["seq"] == op["seq"]
+            and stream.ops[j]["client"] == op["client"]
+        ):
+            j += 1
+        parts = [decode_op(o) for o in stream.ops[i:j]]
+        contents = parts[0] if len(parts) == 1 else GroupOp(ops=parts)
+        out.append(SequencedMessage(
+            client_id=inv_clients[op["client"]],
+            sequence_number=op["seq"],
+            minimum_sequence_number=op["min_seq"],
+            client_sequence_number=0,
+            reference_sequence_number=op["refseq"],
+            type=MessageType.OPERATION, contents=contents,
+        ))
+        i = j
+    return out
 
 
 def coalesce_noops(ops: list[dict]) -> list[dict]:

@@ -57,7 +57,7 @@ class HostDocReplay:
         if not (s.seq <= refseq or s.client == client):
             return False
         if s.removed_seq != NOT_REMOVED and (
-            s.removed_seq <= refseq or (s.removers >> client) & 1
+            s.removed_seq <= refseq or (s.removers >> (client & 31)) & 1
         ):
             return False
         return True
@@ -125,7 +125,7 @@ class HostDocReplay:
                 if op["kind"] == KIND_REMOVE:
                     if s.removed_seq == NOT_REMOVED:
                         s.removed_seq = op["seq"]
-                    s.removers |= 1 << client
+                    s.removers |= 1 << (client & 31)
                 else:
                     s.prop[op["prop_key"]] = op["prop_val"]
             E += vlen

@@ -58,10 +58,12 @@ class TpuMergeSidecar:
         self.max_capacity = max_capacity
         self._table = make_table(max_docs, capacity)
         self._slots: dict[tuple[str, str, str], int] = {}
+        # the encoded stream is the single canonical per-doc history:
+        # grow re-replays it on device, eviction decodes it back into
+        # sequenced messages for the scalar replica (no duplicate raw
+        # log — advisor r2)
         self._streams: list[DocStream] = []
         self._queued: list[list[dict]] = []
-        # full raw inner-message history per slot: the recovery source
-        self._raw: list[list[SequencedMessage]] = []
         # slot -> host oracle replica (evicted documents)
         self._host: dict[int, MergeTreeClient] = {}
         self._applies = 0
@@ -83,7 +85,6 @@ class TpuMergeSidecar:
         self._slots[key] = slot
         self._streams.append(DocStream())
         self._queued.append([])
-        self._raw.append([])
         return slot
 
     def subscribe(self, server, document_id: str, datastore_id: str,
@@ -126,16 +127,21 @@ class TpuMergeSidecar:
                 # retention needed (eviction is one-way)
                 self._host[slot].apply_msg(inner)
                 continue
-            self._raw[slot].append(inner)
             before = len(stream.ops)
+            before_payloads = len(stream.payloads)
             try:
                 self._encode(stream, inner)
             except ValueError:
-                # inexpressible in tensor form (e.g. more interned
-                # property channels than PROP_CHANNELS): this document
-                # leaves the device path, full-fidelity host replica
-                # takes over
+                # inexpressible in tensor form (more interned property
+                # channels than PROP_CHANNELS, or a 33rd client): this
+                # document leaves the device path. Roll the partial
+                # encode back so the canonical stream stays exact, then
+                # the full-fidelity host replica takes over — seeded by
+                # decoding the stream, plus the message that failed.
+                del stream.ops[before:]
+                del stream.payloads[before_payloads:]
                 self._evict(slot)
+                self._host[slot].apply_msg(inner)
                 continue
             self._queued[slot].extend(stream.ops[before:])
 
@@ -246,6 +252,8 @@ class TpuMergeSidecar:
         device batch path."""
         if slot in self._host:
             return
+        from ..ops.host_bridge import decode_stream
+
         self.evict_count += 1
         obs = MergeTreeClient(f"sidecar-host-{slot}")
         obs.start_collaboration(f"sidecar-host-{slot}")
@@ -260,9 +268,8 @@ class TpuMergeSidecar:
         self._table = self._table._replace(
             count=jnp.asarray(count), overflow=jnp.asarray(overflow),
         )
-        for msg in self._raw[slot]:
+        for msg in decode_stream(self._streams[slot]):
             obs.apply_msg(msg)
-        self._raw[slot] = []  # replica is the state now
 
     # ------------------------------------------------------------------
     # reads (service-side summarization / validation)

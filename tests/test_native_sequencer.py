@@ -226,10 +226,15 @@ def test_native_sequencer_serves_local_orderer(monkeypatch):
 
 
 def test_native_throughput_exceeds_python():
-    """Diagnostic: batch ticketing beats the Python loop at realistic
-    quorum sizes (msn = min over clients is the per-op cost the
-    multiset kills; deli documents see hundreds of clients)."""
+    """The array lane (one FFI call, numeric in/out, zero per-op Python
+    objects — what the TPU sidecar consumes) must beat the Python
+    per-op loop by >=5x at realistic quorum sizes. The object-building
+    ``ticket_batch`` wrapper can't win — SequencedMessage construction
+    dominates both sides — so the service plane feeds tensors, not
+    dataclasses (VERDICT r2 #7)."""
     import time
+
+    import numpy as np
 
     n_clients, n = 200, 20000
     py = DocumentSequencer("doc")
@@ -250,11 +255,85 @@ def test_native_throughput_exceeds_python():
         py.ticket(cid, o)
     t_py = time.perf_counter() - t0
 
+    cids = np.array([nat.intern_id(cid) for cid, _ in ops], np.int64)
+    csns = np.array([o.client_sequence_number for _, o in ops],
+                    np.int64)
+    refs = np.array([o.reference_sequence_number for _, o in ops],
+                    np.int64)
     t0 = time.perf_counter()
-    nat.ticket_batch(ops)
+    out_seq, out_msn, out_status = nat.ticket_batch_arrays(
+        cids, csns, refs
+    )
     t_nat = time.perf_counter() - t0
     print(f"python={n / t_py:.0f} ops/s native={n / t_nat:.0f} ops/s "
           f"speedup={t_py / t_nat:.1f}x")
+    assert (out_status == 0).all()
     assert py.sequence_number == nat.sequence_number
     assert py.minimum_sequence_number == nat.minimum_sequence_number
-    assert t_nat < t_py  # native must not be slower
+    assert int(out_seq[-1]) == py.sequence_number
+    assert int(out_msn[-1]) == py.minimum_sequence_number
+    assert t_nat * 5 < t_py, (
+        f"array lane only {t_py / t_nat:.1f}x vs Python"
+    )
+
+
+def test_ticket_batch_arrays_matches_scalar_oracle():
+    """Differential: the array lane's (seq, msn, status) stream equals
+    the Python oracle's op-for-op, including nack/duplicate statuses."""
+    import numpy as np
+
+    rng = random.Random(7)
+    py = DocumentSequencer("doc")
+    nat = native.NativeSequencerCore("doc")
+    names = [f"c{i}" for i in range(6)]
+    for s in (py, nat):
+        for cid in names:
+            s.client_join(ClientDetail(cid))
+    csn_state = {cid: 0 for cid in names}
+    ops = []
+    for _ in range(400):
+        cid = rng.choice(names)
+        if rng.random() < 0.1:
+            csn = csn_state[cid] + rng.choice([0, 2])  # dup or gap
+        else:
+            csn_state[cid] += 1
+            csn = csn_state[cid]
+        refseq = py.sequence_number - rng.choice([0, 0, 1])
+        ops.append((cid, op(csn, max(0, refseq))))
+        # tick the oracle as we go so refseq choices stay plausible
+        py.ticket(cid, ops[-1][1])
+
+    # replay the identical stream through both implementations fresh
+    py2 = DocumentSequencer("doc")
+    for cid in names:
+        py2.client_join(ClientDetail(cid))
+    expected = []
+    for cid, o in ops:
+        res = py2.ticket(cid, o)
+        if res.message is not None:
+            expected.append(
+                (0, res.message.sequence_number,
+                 res.message.minimum_sequence_number)
+            )
+        elif res.nack is None:
+            expected.append((2, -1, -1))
+        else:
+            expected.append((-1, -1, -1))
+
+    cids = np.array([nat.intern_id(cid) for cid, _ in ops], np.int64)
+    csns = np.array([o.client_sequence_number for _, o in ops],
+                    np.int64)
+    refs = np.array([o.reference_sequence_number for _, o in ops],
+                    np.int64)
+    out_seq, out_msn, out_status = nat.ticket_batch_arrays(
+        cids, csns, refs
+    )
+    for i, (status, seq, msn) in enumerate(expected):
+        if status == 0:
+            assert out_status[i] == 0
+            assert out_seq[i] == seq
+            assert out_msn[i] == msn
+        elif status == 2:
+            assert out_status[i] == 2
+        else:
+            assert out_status[i] not in (0, 2)
