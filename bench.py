@@ -40,7 +40,8 @@ import sys
 import tempfile
 import time
 
-STAGES = ("config1", "config2", "config3", "config4", "config5")
+STAGES = ("probe", "config1", "config2", "config3", "config4",
+          "config5")
 
 
 # ======================================================================
@@ -49,7 +50,13 @@ STAGES = ("config1", "config2", "config3", "config4", "config5")
 def _stage_env_setup(backend: str) -> None:
     """Must run before the first jax import in the stage process. The
     image's sitecustomize force-selects the axon TPU platform at
-    interpreter start; only a config update overrides it."""
+    interpreter start; only a config update overrides it. The
+    persistent compilation cache makes retries and later stages skip
+    the 20-40s first-compile cost (VERDICT r2 #1)."""
+    cache = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+    )
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
     if backend == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
@@ -73,25 +80,38 @@ def _build_streams(n_streams: int, steps: int, clients: int, seed0: int):
     return raw, encoded
 
 
-def _time_kernel(table_fn, batch, reps: int, cooldown: float):
-    """Best-of-reps window time (the tunneled v5e duty-cycle throttles
-    under sustained dispatch; cooldown lets it recover)."""
-    import jax
+def _sync(out):
+    """Force completion. block_until_ready through the axon tunnel
+    returns at DISPATCH, not completion (measured round 3: a 320ms
+    window 'finished' in 7ms under block_until_ready) — only a
+    device->host transfer provably includes the compute. Every timing
+    in this harness must pass through here."""
+    import numpy as np
 
+    leaf = out.count if hasattr(out, "count") else out
+    return np.asarray(leaf)
+
+
+def _time_kernel(table_fn, batch, reps: int, cooldown: float):
+    """Best-of-reps window time (transfer-forced, see _sync). Returns
+    the warmup (compile) seconds alongside so every stage record
+    separates compile from run (VERDICT r2 #1)."""
     from fluidframework_tpu.ops import apply_window
 
+    t0 = time.perf_counter()
     out = apply_window(table_fn(), batch)  # warmup/compile
-    jax.block_until_ready(out)
+    _sync(out)
+    compile_s = time.perf_counter() - t0
     times = []
     for _ in range(reps):
         fresh = table_fn()
-        jax.block_until_ready(fresh)
+        _sync(fresh)
         time.sleep(cooldown)
         t0 = time.perf_counter()
         out = apply_window(fresh, batch)
-        jax.block_until_ready(out)
+        _sync(out)
         times.append(time.perf_counter() - t0)
-    return out, min(times), times
+    return out, min(times), times, compile_s
 
 
 def _cpp_baseline(encoded, min_seconds: float = 1.0):
@@ -159,7 +179,7 @@ def _kernel_stage(name: str, docs: int, base: int, steps: int,
     raw, encoded = _build_streams(base, steps, clients, seed0=seed0)
     tiled = [encoded[d % base] for d in range(docs)]
     batch = build_batch(tiled)
-    table, best, times = _time_kernel(
+    table, best, times, compile_s = _time_kernel(
         lambda: make_table(docs, capacity), batch, reps, cooldown
     )
     np_table = fetch(table)
@@ -182,8 +202,77 @@ def _kernel_stage(name: str, docs: int, base: int, steps: int,
         "py_baseline_ops_per_sec": round(py_ops_s, 1),
         "real_ops": real,
         "best_window_time_s": round(best, 4),
+        "compile_s": round(compile_s, 2),
         "window_times_s": [round(t, 4) for t in times],
         "parity": "checksum-verified" if checksums else "cpp-unavailable",
+    }
+
+
+def stage_probe(scale: str, reps: int, cooldown: float) -> dict:
+    """Localizes TPU liveness/compile cost before any heavy stage runs
+    (VERDICT r2 #1: both prior rounds died in backend init/compile with
+    nothing recorded). Records backend-init seconds, a tiny-kernel
+    compile+run on the dispatcher path, and whether the Pallas fast
+    path lowered."""
+    import numpy as np
+
+    t0 = time.perf_counter()
+    import jax
+
+    backend = jax.default_backend()
+    ndev = len(jax.devices())
+    init_s = time.perf_counter() - t0
+
+    from fluidframework_tpu.ops import (
+        apply_window,
+        build_batch,
+        encode_stream,
+        fetch,
+        make_table,
+    )
+    from fluidframework_tpu.testing import FuzzConfig, record_op_stream
+
+    _, stream = record_op_stream(FuzzConfig(
+        n_clients=2, n_steps=20, seed=1, insert_weight=0.6,
+        remove_weight=0.2, annotate_weight=0.1, process_weight=0.1,
+    ))
+    batch = build_batch([encode_stream(stream)])
+    t0 = time.perf_counter()
+    table = apply_window(make_table(1, 128), batch)
+    _sync(table)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    table = apply_window(make_table(1, 128), batch)
+    _sync(table)
+    run_s = time.perf_counter() - t0
+    count = int(np.asarray(fetch(table)["count"])[0])
+
+    pallas = {"attempted": False}
+    if backend == "tpu":
+        from fluidframework_tpu.ops.pallas_merge import (
+            apply_window_pallas,
+        )
+
+        pallas["attempted"] = True
+        try:
+            t0 = time.perf_counter()
+            ptab = apply_window_pallas(make_table(1, 128), batch)
+            _sync(ptab)
+            pallas["compile_s"] = round(time.perf_counter() - t0, 2)
+            ref = fetch(table)
+            got = fetch(ptab)
+            pallas["matches_xla"] = all(
+                bool(np.array_equal(ref[f], got[f])) for f in ref
+            )
+        except Exception as e:  # noqa: BLE001 - recorded, not raised
+            pallas["error"] = f"{type(e).__name__}: {e}"[:400]
+    return {
+        "devices": ndev,
+        "backend_init_s": round(init_s, 2),
+        "tiny_compile_s": round(compile_s, 2),
+        "tiny_run_s": round(run_s, 4),
+        "live_slots": count,
+        "pallas": pallas,
     }
 
 
@@ -293,14 +382,16 @@ def stage_config3(scale: str, reps: int, cooldown: float) -> dict:
     streams = [build_stream(m) for m in range(matrices)]
     total_ops = sum(ms.op_count for ms in streams)
 
+    t0 = time.perf_counter()
     table = apply_matrix_batch(streams, capacity)  # warmup/compile
-    jax.block_until_ready(table)
+    _sync(table)
+    compile_s = time.perf_counter() - t0
     times = []
     for _ in range(reps):
         time.sleep(cooldown)
         t0 = time.perf_counter()
         table = apply_matrix_batch(streams, capacity)
-        jax.block_until_ready(table)
+        _sync(table)
         times.append(time.perf_counter() - t0)
     best = min(times)
     np_table = fetch(table)
@@ -358,6 +449,7 @@ def stage_config3(scale: str, reps: int, cooldown: float) -> dict:
         "py_baseline_ops_per_sec": round(py_ops_s, 1),
         "real_ops": total_ops,
         "best_window_time_s": round(best, 4),
+        "compile_s": round(compile_s, 2),
         "extract_one_matrix_s": round(extract_s, 4),
         "window_times_s": [round(t, 4) for t in times],
         "parity": f"grid {len(grid)}x{len(grid[0]) if grid else 0}",
@@ -411,14 +503,16 @@ def stage_config4(scale: str, reps: int, cooldown: float) -> dict:
         for f in ("kind", "pos", "n", "muted")
     ])
 
+    t0 = time.perf_counter()
     out = rebase_over_trunk(c_stack, trunk)  # warmup/compile
-    jax.block_until_ready(out)
+    np.asarray(out.kind)
+    compile_s = time.perf_counter() - t0
     times = []
     for _ in range(reps):
         time.sleep(cooldown)
         t0 = time.perf_counter()
         out = rebase_over_trunk(c_stack, trunk)
-        jax.block_until_ready(out)
+        np.asarray(out.kind)
         times.append(time.perf_counter() - t0)
     best = min(times)
     rebases = docs * k_trunk
@@ -456,6 +550,7 @@ def stage_config4(scale: str, reps: int, cooldown: float) -> dict:
         "py_baseline_ops_per_sec": round(py_ops_s, 1),
         "real_ops": rebases,
         "best_window_time_s": round(best, 4),
+        "compile_s": round(compile_s, 2),
         "window_times_s": [round(t, 4) for t in times],
         "parity": "applied-state-verified x4",
         "unit": "rebases/s",
@@ -544,7 +639,7 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
         if pending:
             total_real += sidecar.apply()
             pending = 0
-    jax.block_until_ready(sidecar._table)
+    _sync(sidecar._table)
     elapsed = time.perf_counter() - t0
 
     # scalar-python pipeline baseline: same sequencer work, per-doc
@@ -590,6 +685,7 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
 
 
 STAGE_FNS = {
+    "probe": stage_probe,
     "config1": stage_config1,
     "config2": stage_config2,
     "config3": stage_config3,
@@ -610,8 +706,21 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         "scale": scale,
         "stage_elapsed_s": round(time.perf_counter() - t0, 1),
     })
+    # persist the full-scale result BEFORE the fixed-scale companion:
+    # if the companion pushes the child past the subprocess timeout,
+    # the completed result must not be lost (code-review r3)
     with open(out_path, "w") as f:
         json.dump(result, f)
+    if scale == "full" and name != "probe":
+        # fixed-size companion record (same dims as the CPU-fallback
+        # scale) so round-over-round and backend-to-backend trends are
+        # readable (VERDICT r2 weak #9)
+        t1 = time.perf_counter()
+        fixed = STAGE_FNS[name]("cpu", max(1, reps // 2), 0.5)
+        fixed["stage_elapsed_s"] = round(time.perf_counter() - t1, 1)
+        result["fixed_scale"] = fixed
+        with open(out_path, "w") as f:
+            json.dump(result, f)
 
 
 # ======================================================================
@@ -627,17 +736,31 @@ def _spawn(stage: str, backend: str, scale: str, reps: int,
         "--reps", str(reps), "--cooldown", str(cooldown),
         "--out", out_path,
     ]
+    def salvage(err):
+        # run_stage persists the main result BEFORE the fixed-scale
+        # companion; if the child died in the companion (timeout or
+        # crash), the completed full-scale record is still on disk
+        try:
+            with open(out_path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None, err
+        data["companion_failure"] = err
+        return data, ""
+
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         if proc.returncode != 0:
-            return None, f"rc={proc.returncode}: {proc.stderr[-800:]}"
+            return salvage(f"rc={proc.returncode}: {proc.stderr[-800:]}")
         with open(out_path) as f:
             return json.load(f), ""
     except subprocess.TimeoutExpired:
-        return None, f"timeout after {timeout:.0f}s (backend={backend})"
+        return salvage(
+            f"timeout after {timeout:.0f}s (backend={backend})"
+        )
     except (OSError, json.JSONDecodeError) as e:
         return None, f"{type(e).__name__}: {e}"
     finally:
@@ -669,11 +792,15 @@ def orchestrate(smoke: bool, stages: list[str], reps: int,
             remaining = total_budget - (time.monotonic() - t_start)
             plan = []
             n_tpu = 1 if tpu_seen_ok else 2
+            # the probe is cheap by construction: tighter timeout, and
+            # it runs first so a dead tunnel is detected at low cost
+            tmo = min(tpu_timeout, 240.0) if stage == "probe" else \
+                tpu_timeout
             # admission: the FULL worst-case plan must fit the budget
             if not tpu_dead and remaining > (
-                n_tpu * tpu_timeout + cpu_timeout
+                n_tpu * tmo + cpu_timeout
             ):
-                plan += [("tpu", "full", reps, cd, tpu_timeout)] * n_tpu
+                plan += [("tpu", "full", reps, cd, tmo)] * n_tpu
             plan += [("cpu", "cpu", max(1, reps // 2), 0.5, cpu_timeout)]
         stage_tpu_ok = False
         for backend, scale, r, cd, tmo in plan:
@@ -725,7 +852,8 @@ def main() -> None:
                          args.total_budget)
 
     primary = detail["stages"].get("config2") or next(
-        iter(detail["stages"].values()), None
+        (v for k, v in detail["stages"].items()
+         if "kernel_ops_per_sec" in v), None
     )
     if primary is None:
         print(json.dumps({

@@ -6,6 +6,10 @@ from .definitions import DeltaStreamConnection, DocumentService
 from .file_driver import load_document, save_document
 from .local_driver import LocalDocumentService, LocalDocumentServiceFactory
 from .replay_driver import ReplayDocumentService
+from .socket_driver import (
+    SocketDocumentService,
+    SocketDocumentServiceFactory,
+)
 
 __all__ = [
     "DeltaStreamConnection",
@@ -13,6 +17,8 @@ __all__ = [
     "LocalDocumentService",
     "LocalDocumentServiceFactory",
     "ReplayDocumentService",
+    "SocketDocumentService",
+    "SocketDocumentServiceFactory",
     "load_document",
     "save_document",
 ]

@@ -1,0 +1,267 @@
+"""Socket driver: DocumentService over a TCP connection to the
+networked ingress (service/ingress.py).
+
+Reference: the production networked driver pair —
+packages/drivers/driver-base/src/documentDeltaConnection.ts (:41, the
+connect_document handshake + op/nack events) and
+packages/drivers/routerlicious-driver/src/documentService.ts (:37, the
+three planes over the wire). One TCP connection per DocumentService; a
+daemon receive-pump thread dispatches broadcast ops to the container's
+callback and pairs request/response frames by ``rid``.
+
+The client surface is synchronous (the loader's Container is
+synchronous and single-threaded). Two daemon threads serve it:
+
+- the RECV PUMP parses frames and only ever sets rid events or
+  enqueues broadcasts — it never calls back into user code, so a
+  request issued from any thread can always complete;
+- the DISPATCH thread delivers op/nack broadcasts to the container's
+  callbacks while holding ``self.lock``. The container's inbound path
+  may itself issue blocking requests (gap refetch calls read_ops —
+  deltaManager.ts:883), which is safe because the recv pump stays
+  free.
+
+Application code MUST hold the same ``service.lock`` around container
+calls (flush/process/reads) — the container is not thread-safe and the
+dispatch thread mutates it; `with svc.lock: container.flush()`.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import queue
+import socket
+import struct
+import sys
+import threading
+from typing import Callable, Optional
+
+from ..protocol.messages import DocumentMessage, Nack, NackErrorType, SequencedMessage
+from ..protocol.serialization import decode_contents, message_from_json
+from ..service.ingress import document_message_to_json, pack_frame
+
+_LEN = struct.Struct(">I")
+
+
+class SocketDocumentService:
+    """IDocumentService over the wire; create via the factory."""
+
+    def __init__(self, host: str, port: int, document_id: str,
+                 timeout: float = 30.0):
+        self.document_id = document_id
+        self.lock = threading.RLock()
+        self._timeout = timeout
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._send_lock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._pending: dict[int, tuple[threading.Event, list]] = {}
+        self._pending_lock = threading.Lock()
+        self._on_message: Optional[Callable] = None
+        self._on_nack: Optional[Callable] = None
+        self._connected = threading.Event()
+        self._closed = False
+        self.last_error: Optional[str] = None
+        self._inbox: queue.Queue[Optional[dict]] = queue.Queue()
+        self._pump = threading.Thread(
+            target=self._recv_loop, daemon=True,
+            name=f"socket-recv-{document_id}",
+        )
+        self._pump.start()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"socket-dispatch-{document_id}",
+        )
+        self._dispatcher.start()
+
+    # -- framing -------------------------------------------------------
+
+    def _send(self, data: dict) -> None:
+        frame = pack_frame(data)
+        with self._send_lock:
+            self._sock.sendall(frame)
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self._sock.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _recv_loop(self) -> None:
+        try:
+            while not self._closed:
+                header = self._recv_exact(_LEN.size)
+                if header is None:
+                    break
+                (length,) = _LEN.unpack(header)
+                body = self._recv_exact(length)
+                if body is None:
+                    break
+                frame = json.loads(body.decode("utf-8"))
+                rid = frame.get("rid")
+                if rid is not None:
+                    with self._pending_lock:
+                        pending = self._pending.pop(rid, None)
+                    if pending is not None:
+                        event, slot = pending
+                        slot.append(frame)
+                        event.set()
+                    continue
+                if frame.get("type") == "connected":
+                    self._connected.set()
+                else:
+                    self._inbox.put(frame)
+        finally:
+            # even on a parse error the shutdown protocol must run, or
+            # the dispatcher and every pending request hang
+            self._closed = True
+            self._inbox.put(None)
+            with self._pending_lock:
+                waiters = list(self._pending.values())
+                self._pending.clear()
+            for event, _slot in waiters:
+                event.set()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            frame = self._inbox.get()
+            if frame is None:
+                break
+            with self.lock:
+                self._deliver(frame)
+
+    def _deliver(self, frame: dict) -> None:
+        kind = frame.get("type")
+        if kind == "error":
+            # a submit the server could neither sequence nor nack
+            # (e.g. undecodable op contents): losing it silently would
+            # stall the CSN stream with no diagnostic
+            self.last_error = frame.get("message", "server error")
+            print(
+                f"socket-driver[{self.document_id}]: server error: "
+                f"{self.last_error}",
+                file=sys.stderr,
+            )
+            return
+        if kind == "op" and self._on_message is not None:
+            self._on_message(message_from_json(frame["msg"]))
+        elif kind == "nack" and self._on_nack is not None:
+            from ..service.ingress import document_message_from_json
+
+            op = frame.get("operation")
+            self._on_nack(Nack(
+                operation=document_message_from_json(op)
+                if op else None,
+                sequence_number=frame["sequence_number"],
+                error_type=NackErrorType(frame["error_type"]),
+                message=frame.get("message", ""),
+                retry_after_seconds=frame.get("retry_after_seconds"),
+            ))
+
+    def _request(self, data: dict) -> dict:
+        rid = next(self._rid)
+        event: threading.Event = threading.Event()
+        slot: list = []
+        with self._pending_lock:
+            self._pending[rid] = (event, slot)
+        self._send(dict(data, rid=rid))
+        if not event.wait(self._timeout):
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+            raise TimeoutError(f"no response to {data['type']}")
+        if not slot:
+            raise ConnectionError("connection closed mid-request")
+        frame = slot[0]
+        if frame.get("type") == "error":
+            raise RuntimeError(frame.get("message", "server error"))
+        return frame
+
+    # -- DocumentService surface ---------------------------------------
+
+    def connect_to_delta_stream(
+        self,
+        client_id: str,
+        on_message: Callable[[SequencedMessage], None],
+        on_nack: Optional[Callable[[Nack], None]] = None,
+    ) -> "SocketDeltaConnection":
+        self._on_message = on_message
+        self._on_nack = on_nack
+        self._send({
+            "type": "connect_document",
+            "document_id": self.document_id,
+            "client_id": client_id,
+        })
+        if not self._connected.wait(self._timeout):
+            raise TimeoutError("connect_document handshake timed out")
+        return SocketDeltaConnection(self, client_id)
+
+    def read_ops(self, from_seq: int,
+                 to_seq: Optional[int] = None) -> list[SequencedMessage]:
+        frame = self._request({
+            "type": "read_ops", "document_id": self.document_id,
+            "from_seq": from_seq, "to_seq": to_seq,
+        })
+        return [message_from_json(m) for m in frame["msgs"]]
+
+    def get_latest_summary(self) -> Optional[tuple[int, dict]]:
+        frame = self._request({
+            "type": "fetch_summary", "document_id": self.document_id,
+        })
+        if frame.get("sequence_number") is None:
+            return None
+        return frame["sequence_number"], decode_contents(frame["summary"])
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class SocketDeltaConnection:
+    """IDocumentDeltaConnection over the wire."""
+
+    def __init__(self, service: SocketDocumentService, client_id: str):
+        self._service = service
+        self.client_id = client_id
+        self.open = True
+
+    def submit(self, op: DocumentMessage) -> None:
+        assert self.open, "submit on closed connection"
+        self._service._send({
+            "type": "submitOp",
+            "document_id": self._service.document_id,
+            "op": document_message_to_json(op),
+        })
+
+    def disconnect(self) -> None:
+        if not self.open:
+            return
+        self.open = False
+        try:
+            self._service._send({
+                "type": "disconnect_document",
+                "document_id": self._service.document_id,
+            })
+        except OSError:
+            pass  # server already gone; the session cleans up
+
+
+class SocketDocumentServiceFactory:
+    """IDocumentServiceFactory against a running dev service
+    (`python -m fluidframework_tpu.service`)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 7070):
+        self.host = host
+        self.port = port
+
+    def create_document_service(self, document_id: str
+                                ) -> SocketDocumentService:
+        return SocketDocumentService(self.host, self.port, document_id)

@@ -111,6 +111,11 @@ class SharedMatrix(SharedObject, EventEmitter):
 
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
+        # see SharedString.process_core: load-time catch-up must apply
+        # with collab view tracking, else concurrent streams diverge
+        for ax in (self.rows, self.cols):
+            if not ax.mergetree.collab.collaborating:
+                ax.start_collaboration(self.client_id or "\x00detached")
         contents = msg.contents
         target = contents["target"]
         if target in ("rows", "cols"):

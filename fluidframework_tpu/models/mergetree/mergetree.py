@@ -44,9 +44,15 @@ class MergeTree:
 
     def start_collaboration(self, client_id: int, min_seq: int = 0,
                             current_seq: int = 0) -> None:
+        """startOrUpdateCollaboration (client.ts): never REGRESSES the
+        window — a container that replayed the op log while detached
+        (load-time catch-up) has already advanced current_seq/min_seq,
+        and clobbering them back to 0 would make every pre-connect
+        segment invisible to the first local op's refSeq view."""
         self.collab.client_id = client_id
-        self.collab.min_seq = min_seq
-        self.collab.current_seq = current_seq
+        self.collab.min_seq = max(self.collab.min_seq, min_seq)
+        self.collab.current_seq = max(self.collab.current_seq,
+                                      current_seq)
         self.collab.collaborating = True
 
     # ------------------------------------------------------------------

@@ -119,6 +119,16 @@ class SharedString(SharedObject, EventEmitter):
 
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
+        # A channel materialized during load-time catch-up processes
+        # sequenced ops BEFORE the container connects. It must still
+        # track (seq, refSeq) views and tombstones — non-collab apply
+        # resolves positions at the tip view and silently diverges on
+        # concurrent streams (found by tools/net_stress). Enter
+        # collaboration in observer mode; _on_connect renames us later.
+        if not self.client.mergetree.collab.collaborating:
+            self.client.start_collaboration(
+                self.client_id or "\x00detached"
+            )
         assert local == (msg.client_id == self.client.long_client_id)
         if isinstance(msg.contents, IntervalOp):
             op = msg.contents

@@ -6,249 +6,98 @@ for the reference's per-op B-tree walk (mergeTree.ts ``insertingWalk``
 :1723, ``markRangeRemoved`` :1908, ``annotateRange`` :1864) and its
 ``PartialSequenceLengths`` incremental structure (partialLengths.ts:234).
 
-Position resolution = visibility mask + exclusive cumsum + argmax:
-
-    vlen[i] = length[i] * visible(i; refseq, client)
-    E       = exclusive_cumsum(vlen)
-    target  = first i with (E[i] <= p < E[i]+vlen[i]) or
-              (E[i] == p and stop-eligible(i))
-
-Because ops arrive in sequence order, every slot is acked and the
-incoming op carries the maximum seq, so the reference's ``breakTie``
-(:1705) reduces to "insert before the first stop-eligible slot at the
-boundary". Stop-eligible = any live slot except below-window tombstones
-(the new-length-calculation rules, mergeTree.ts:1003-1025, which this
-framework adopts as canonical — see the scalar oracle).
+Position resolution = visibility mask + exclusive prefix-sum + first-
+true reduction (see merge_step.fused_step for the fused three-phase
+algorithm and its equivalence argument to the reference's
+``breakTie``/``insertingWalk`` semantics).
 
 Within one document ops are sequentially dependent (an op may address
-positions created by the previous one), so the op window is a
-``lax.scan``; parallelism is across documents (``vmap``/sharding over
-the doc axis).
+positions created by the previous one), so the op window is a sequential
+loop; parallelism is across documents (the reference's Kafka-partition
+axis, SURVEY §2.9 axis 1).
 
-TPU performance notes:
-- gathers inside ``lax.scan`` lower catastrophically (~2.7 ms each vs
-  ~20 us standalone, measured on v5e); all restructuring is therefore
-  static pad-shifts + selects, and scalar reads are dynamic slices.
-- every op kind flows through ONE masked pipeline (two structural
-  passes + one stamp pass) instead of ``lax.switch`` branches, which
-  under vmap would execute every branch for every document.
+Two executors share the identical step function:
+
+- XLA (`apply_window_impl`): ``lax.scan`` over the window. Runs on any
+  backend, shards over a doc-axis mesh, and is the reference for the
+  Pallas path. HBM-bound: every scan step streams the whole table.
+- Pallas TPU (`pallas_merge.apply_window_pallas`): one kernel per doc
+  block with the segment table VMEM-RESIDENT across the entire window —
+  HBM traffic drops from O(window × table) to O(table + ops).
+
+``apply_window`` runs the XLA scan by default everywhere; the Pallas
+kernel is OPT-IN via FFTPU_PALLAS=1 on a TPU backend (correct and
+bit-identical, but Mosaic's current lane-reduce codegen loses to the
+pipelined scan on throughput — see _use_pallas).
 """
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
 
-from .segment_table import (
-    KIND_ANNOTATE,
-    KIND_INSERT,
-    KIND_REMOVE,
-    NOT_REMOVED,
-    OpBatch,
-    PROP_CHANNELS,
-    SegmentTable,
-)
-
-
-def _views(table: SegmentTable, refseq, client):
-    """Per-slot visibility at (refseq, client) for one document.
-
-    Returns (vlen, stop, vis):
-      vis  — slot contributes length to the view,
-      stop — slot halts the insert walk at a boundary (everything live
-             except below-window tombstones),
-      vlen — length * vis.
-    """
-    j = jnp.arange(table.capacity, dtype=jnp.int32)
-    alive = j < table.count
-    removed = table.removed_seq != NOT_REMOVED
-    below_window = removed & (table.removed_seq <= table.min_seq)
-    removed_by_viewer = ((table.removers >> client.astype(jnp.uint32)) & 1
-                        ).astype(jnp.bool_)
-    removal_visible = removed & (
-        (table.removed_seq <= refseq) | removed_by_viewer
-    )
-    insert_visible = (table.seq <= refseq) | (table.client == client)
-    vis = alive & ~below_window & insert_visible & ~removal_visible
-    stop = alive & ~below_window
-    vlen = jnp.where(vis, table.length, 0)
-    return vlen, stop, vis
-
-
-def _excl_cumsum(x):
-    c = jnp.cumsum(x)
-    return c - x, c[-1]
-
-
-def _shift1(arr):
-    """arr[j-1] with 0-fill via static pad+slice."""
-    pad = [(1, 0)] + [(0, 0)] * (arr.ndim - 1)
-    return jnp.pad(arr, pad)[: arr.shape[0]]
-
-
-def _shift2(arr):
-    pad = [(2, 0)] + [(0, 0)] * (arr.ndim - 1)
-    return jnp.pad(arr, pad)[: arr.shape[0]]
-
-
-def _restructure(table: SegmentTable, idx, off, add_new, new, want):
-    """The single structural primitive: optionally split slot ``idx``
-    at interior offset ``off`` (>0) and optionally place a new segment
-    after the head — the vectorized form of B-tree node insertion +
-    ``splitLeafSegment`` (mergeTree.ts:1681).
-
-    Layout: [0..idx+split) unchanged (head keeps length ``off``), new
-    slot (if any) at idx+split, suffix shifted right by split+add_new.
-    The split tail lands at idx+split+add_new, which under the suffix
-    shift receives arr[idx] automatically; only length/op_off need
-    scalar fix-ups.
-    """
-    cap = table.capacity
-    j = jnp.arange(cap, dtype=jnp.int32)
-    split = (off > 0).astype(jnp.int32)
-    shift = split + add_new.astype(jnp.int32)
-    wanted = want & (shift > 0)
-
-    overflow = wanted & (table.count + shift > cap)
-    do = wanted & ~overflow
-
-    new_pos = idx + split
-    is_new = do & add_new & (j == new_pos)
-    moved = do & (j >= idx + shift)
-    tail_j = idx + shift  # first moved slot is the split tail
-    tail_fix = do & (split == 1) & (j == tail_j)
-    head_fix = do & (split == 1) & (j == idx)
-
-    def shifted(arr):
-        return jnp.where(shift == 2, _shift2(arr), _shift1(arr))
-
-    def place(arr, new_val):
-        out = jnp.where(moved, shifted(arr), arr)
-        return jnp.where(is_new, new_val, out)
-
-    orig_len = table.length[idx]
-    orig_off = table.op_off[idx]
-
-    length = place(table.length, new["length"])
-    length = jnp.where(head_fix, off, length)
-    length = jnp.where(tail_fix, orig_len - off, length)
-    op_off = place(table.op_off, 0)
-    op_off = jnp.where(tail_fix, orig_off + off, op_off)
-
-    prop = jnp.where(moved[:, None], shifted(table.prop), table.prop)
-    prop = jnp.where(is_new[:, None], 0, prop)
-
-    return table._replace(
-        length=length,
-        seq=place(table.seq, new["seq"]),
-        client=place(table.client, new["client"]),
-        removed_seq=place(table.removed_seq, NOT_REMOVED),
-        removers=place(table.removers, jnp.uint32(0)),
-        op_id=place(table.op_id, new["op_id"]),
-        op_off=op_off,
-        is_marker=place(table.is_marker, new["is_marker"]),
-        prop=prop,
-        count=jnp.where(do, table.count + shift, table.count),
-        overflow=jnp.where(overflow, 1, table.overflow),
-    )
-
-
-def _apply_one(table: SegmentTable, op) -> SegmentTable:
-    """Apply one sequenced op (any kind) to one document via a single
-    masked pipeline: structural pass at pos1, structural pass at pos2,
-    masked stamp pass."""
-    kind = op["kind"]
-    is_ins = kind == KIND_INSERT
-    is_rem = kind == KIND_REMOVE
-    is_ann = kind == KIND_ANNOTATE
-    is_range = is_rem | is_ann
-    refseq, client = op["refseq"], op["client"]
-    cap = table.capacity
-
-    # ---- pass 1: resolve pos1, split/insert -------------------------
-    vlen, stop, _vis = _views(table, refseq, client)
-    E, total = _excl_cumsum(vlen)
-    p1 = op["pos1"]
-
-    # INSERT target: first stop slot with E==p1 or p1 strictly inside.
-    inside = stop & (E <= p1) & (p1 < E + vlen)
-    target = inside | (stop & (E == p1))
-    has = jnp.any(target)
-    idx_ins = jnp.where(has, jnp.argmax(target), table.count)
-    off_ins = jnp.where(
-        has, p1 - E[jnp.clip(idx_ins, 0, cap - 1)], 0
-    )
-    # RANGE boundary split: slot strictly containing p1.
-    strict1 = (E < p1) & (p1 < E + vlen)
-    need1 = jnp.any(strict1)
-    idx_b1 = jnp.argmax(strict1)
-    off_b1 = p1 - E[idx_b1]
-
-    idx1 = jnp.where(is_ins, idx_ins, idx_b1)
-    off1 = jnp.where(is_ins, off_ins, jnp.where(need1, off_b1, 0))
-    valid = jnp.where(is_ins, p1 <= total, True)
-    new = {
-        "length": op["length"],
-        "seq": op["seq"],
-        "client": client,
-        "op_id": op["op_id"],
-        "is_marker": op["is_marker"],
-    }
-    want1 = (is_ins & valid) | (is_range & need1)
-    table = _restructure(table, idx1, off1, is_ins, new, want1)
-
-    # ---- pass 2: range end boundary ---------------------------------
-    vlen, stop, vis = _views(table, refseq, client)
-    E, total = _excl_cumsum(vlen)
-    p2 = op["pos2"]
-    strict2 = (E < p2) & (p2 < E + vlen)
-    need2 = jnp.any(strict2)
-    idx_b2 = jnp.argmax(strict2)
-    off_b2 = p2 - E[idx_b2]
-    table = _restructure(
-        table, idx_b2, jnp.where(need2, off_b2, 0),
-        jnp.zeros((), jnp.bool_), new, is_range & need2,
-    )
-
-    # ---- pass 3: masked stamps --------------------------------------
-    vlen, stop, vis = _views(table, refseq, client)
-    E, _total = _excl_cumsum(vlen)
-    in_range = vis & (vlen > 0) & (E >= p1) & (E + vlen <= p2)
-
-    # REMOVE: first sequenced removal keeps the stamp; later overlapping
-    # removers are recorded in the bitmask (markRangeRemoved :1925).
-    rmask = is_rem & in_range
-    newly = rmask & (table.removed_seq == NOT_REMOVED)
-    bit = jnp.uint32(1) << client.astype(jnp.uint32)
-    removed_seq = jnp.where(newly, op["seq"], table.removed_seq)
-    removers = jnp.where(rmask, table.removers | bit, table.removers)
-
-    # ANNOTATE: LWW stamp on one property channel.
-    amask = is_ann & in_range
-    chan = jnp.arange(PROP_CHANNELS, dtype=jnp.int32) == op["prop_key"]
-    sel = amask[:, None] & chan[None, :]
-    prop = jnp.where(sel, op["prop_val"], table.prop)
-
-    return table._replace(
-        removed_seq=removed_seq,
-        removers=removers,
-        prop=prop,
-        min_seq=jnp.maximum(table.min_seq, op["min_seq"]),
-    )
+from .merge_step import fused_step, state_to_table, table_to_state
+from .segment_table import NOT_REMOVED, OpBatch, SegmentTable
 
 
 def apply_window_impl(table: SegmentTable, batch: OpBatch) -> SegmentTable:
-    """Apply a [docs, window] op batch: scan over the window (ops within
-    a doc are order-dependent), vmap over docs. Pure/jittable."""
-    ops_wd = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), batch._asdict())
+    """XLA executor: scan the fused step over the [docs, window] batch.
+    Pure/jittable; doc axis shards cleanly under shard_map.
 
-    def step(tab, op_d):
-        return jax.vmap(_apply_one)(tab, op_d), None
+    unroll=16 on TPU: the axon runtime charges ~0.3ms per kernel
+    launch, so per-step launch overhead dominates the window (measured
+    2.35 -> 1.35 ms/step at 1024x512); unrolling fuses launches across
+    steps. Kept at 1 elsewhere — CPU tests would only pay 16x compile.
+    """
+    st = table_to_state(table)
+    ops_wd = {
+        f: jnp.swapaxes(getattr(batch, f), 0, 1)[..., None]
+        for f in batch._fields
+    }
 
-    table, _ = jax.lax.scan(step, table, ops_wd)
-    return table
+    def step(carry, op):
+        return fused_step(carry, op), None
+
+    unroll = 16 if jax.default_backend() == "tpu" else 1
+    st, _ = jax.lax.scan(step, st, ops_wd, unroll=unroll)
+    return state_to_table(st, SegmentTable)
 
 
-apply_window = jax.jit(apply_window_impl, donate_argnums=0)
+# NO donate_argnums: donation serializes back-to-back windows on the
+# axon runtime. NOTE on timing this path: block_until_ready through
+# the axon tunnel returns at dispatch, NOT completion — any honest
+# measurement must force a device->host transfer (np.asarray of an
+# output) to include the compute (bench.py does).
+_apply_window_xla = jax.jit(apply_window_impl)
+
+
+def _use_pallas(table: SegmentTable) -> bool:
+    # Opt-in (FFTPU_PALLAS=1): the Mosaic kernel is correctness-proven
+    # on-chip but the XLA scan currently wins on throughput (26M vs
+    # ~6M ops/s at 1024x1024 — the scan pipelines HBM traffic across
+    # steps, while the VMEM-resident kernel is VPU-bound on ~150
+    # vector ops x capacity lanes per op). Revisit with the two-level
+    # blocked layout (per-128-slot partial sums) before making default.
+    if os.environ.get("FFTPU_PALLAS") != "1":
+        return False
+    if table.capacity % 128 != 0:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # pragma: no cover - backend init failure
+        return False
+
+
+def apply_window(table: SegmentTable, batch: OpBatch) -> SegmentTable:
+    """Apply a [docs, window] op batch. XLA scan by default; the
+    VMEM-resident Pallas kernel when FFTPU_PALLAS=1 on TPU. Both run
+    the same fused step and agree bit-for-bit."""
+    if _use_pallas(table):
+        from .pallas_merge import apply_window_pallas
+
+        return apply_window_pallas(table, batch)
+    return _apply_window_xla(table, batch)
 
 
 @jax.jit

@@ -1,0 +1,156 @@
+"""VMEM-resident Pallas merge kernel (TPU fast path).
+
+The XLA scan executor streams the whole segment table HBM->VMEM->HBM on
+EVERY window step (~1.6ms/step for 1k docs x 1k slots on a v5e,
+transfer-forced timing — the round-3 measured bottleneck). This kernel
+grids over doc blocks, loads each block's slot state into VMEM ONCE,
+runs the entire op window in a fori_loop against the resident state,
+and writes back once: HBM traffic collapses from O(window x table) to
+O(table + ops).
+
+Two Mosaic restrictions shape the code: there is no cumsum lowering
+(merge_step's Hillis-Steele ladder runs instead — cheap in VMEM), and
+dynamic lane-axis indexing is rejected ("cannot statically prove index
+is a multiple of 128"), so per-step op columns are extracted from the
+[docs, window] op arrays with a masked reduce rather than a slice.
+
+Correctness story: the step function is shared verbatim with the XLA
+executor (tests/test_pallas_merge.py asserts bit-equality on fuzzed
+streams), which in turn is differential-tested against the scalar
+Python oracle and the C++ replayer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .merge_step import (
+    OP_COLS,
+    SLOT_FIELDS,
+    STATE_FIELDS,
+    _excl_cumsum_ladder,
+    fused_step,
+    state_to_table,
+    table_to_state,
+)
+from .segment_table import KIND_NOOP, NOT_REMOVED, OpBatch, SegmentTable
+
+# docs per grid block, sized so 12 resident slot arrays + Mosaic's
+# scoped temporaries (~3x the state, measured: block 128 x cap 1024
+# wanted 20MB) fit the ~16MB v5e VMEM
+DOC_BLOCK = 128
+
+
+def _doc_block(cap: int, docs: int) -> int:
+    budget = 12 * 1024 * 1024  # leave headroom for op blocks
+    per_doc = cap * 4 * 72     # measured: block 64 x cap 1024 -> 17.8M
+    block = min(DOC_BLOCK, max(8, budget // per_doc // 8 * 8))
+    return min(block, max(8, docs))
+
+
+def _kernel(*refs):
+    n_state = len(STATE_FIELDS)
+    n_in = n_state + len(OP_COLS)
+    in_refs = dict(zip(STATE_FIELDS, refs[:n_state]))
+    op_refs = dict(zip(OP_COLS, refs[n_state:n_in]))
+    out_refs = dict(zip(STATE_FIELDS, refs[n_in:]))
+    window = op_refs["kind"].shape[-1]
+    D = op_refs["kind"].shape[0]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (D, window), 1)
+
+    for f in STATE_FIELDS:  # load once; resident for the whole window
+        out_refs[f][:] = in_refs[f][:]
+
+    def body(w, _):
+        st = {f: out_refs[f][:] for f in STATE_FIELDS}
+        # op column w as a masked reduce: Mosaic cannot prove alignment
+        # of dynamic lane-axis slices, so never index [:, w] directly
+        sel = lane == w
+        op = {
+            g: jnp.sum(
+                jnp.where(sel, op_refs[g][:], 0),
+                axis=-1, keepdims=True,
+            )
+            for g in OP_COLS
+        }
+        st = fused_step(st, op, excl_cumsum=_excl_cumsum_ladder)
+        for f in STATE_FIELDS:
+            out_refs[f][:] = st[f]
+        return 0
+
+    jax.lax.fori_loop(0, window, body, 0)
+
+
+def _pallas_call(state: dict, ops: dict,
+                 interpret: bool = False) -> dict:
+    docs, cap = state["length"].shape
+    window = ops["kind"].shape[-1]
+    block = _doc_block(cap, docs)
+    if docs % block:
+        block = docs  # direct callers with tiny doc counts (tests)
+    grid = (docs // block,)
+
+    def spec(cols):
+        return pl.BlockSpec(
+            (block, cols), lambda i: (i, 0), memory_space=pltpu.VMEM,
+        )
+
+    state_specs = [
+        spec(cap) if f in SLOT_FIELDS else spec(1) for f in STATE_FIELDS
+    ]
+    op_specs = [spec(window) for _ in OP_COLS]
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=tuple(
+            jax.ShapeDtypeStruct(state[f].shape, state[f].dtype)
+            for f in STATE_FIELDS
+        ),
+        grid=grid,
+        in_specs=state_specs + op_specs,
+        out_specs=tuple(state_specs),
+        input_output_aliases={
+            i: i for i in range(len(STATE_FIELDS))
+        },
+        interpret=interpret,
+    )(*[state[f] for f in STATE_FIELDS],
+      *[ops[f] for f in OP_COLS])
+    return dict(zip(STATE_FIELDS, out))
+
+
+_call = jax.jit(_pallas_call)
+
+
+def apply_window_pallas(table: SegmentTable,
+                        batch: OpBatch) -> SegmentTable:
+    """Pallas entry: pad the doc axis to a block multiple (padded docs
+    are empty and receive only NOOP ops), run the kernel, unpad."""
+    docs = table.docs
+    block = _doc_block(table.capacity, docs)
+    padded = max(block, -(-docs // block) * block)
+
+    state = table_to_state(table)
+    ops = {
+        f: getattr(batch, f).astype(jnp.int32) for f in OP_COLS
+    }
+    if padded != docs:
+        pad = padded - docs
+
+        def pad0(a, fill=0):
+            cfg = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return jnp.pad(a, cfg, constant_values=fill)
+
+        state = {
+            f: pad0(a, NOT_REMOVED if f == "removed_seq" else 0)
+            for f, a in state.items()
+        }
+        # padded docs must see NOOP ops, not INSERTs of zeros
+        ops = {
+            f: pad0(a, KIND_NOOP if f == "kind" else 0)
+            for f, a in ops.items()
+        }
+    out = _call(state, ops)
+    if padded != docs:
+        out = {f: a[:docs] for f, a in out.items()}
+    return state_to_table(out, SegmentTable)

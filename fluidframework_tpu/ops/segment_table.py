@@ -64,8 +64,9 @@ def make_table(docs: int, capacity: int) -> SegmentTable:
     shape = (docs, capacity)
 
     def zeros():
-        # distinct buffers: apply_window donates the whole table, and
-        # aliased buffers cannot be donated twice
+        # distinct buffers: the Pallas path aliases each table array to
+        # its output (input_output_aliases), and shared buffers cannot
+        # be aliased twice
         return jnp.zeros(shape, jnp.int32)
 
     return SegmentTable(

@@ -3,6 +3,7 @@ local server, TPU merge sidecar.
 
 Reference analogue: server/routerlicious/packages/*.
 """
+from .ingress import AlfredServer
 from .lambdas import (
     BroadcasterLambda,
     OpLog,
@@ -16,6 +17,7 @@ from .sequencer import DocumentSequencer, TicketResult
 from .tpu_sidecar import TpuMergeSidecar
 
 __all__ = [
+    "AlfredServer",
     "BroadcasterLambda",
     "DeltaConnection",
     "DocumentSequencer",

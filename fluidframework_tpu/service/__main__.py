@@ -1,0 +1,31 @@
+"""Runnable single-process dev service — the tinylicious analogue.
+
+Reference: server/tinylicious (single-tenant, no-Kafka, in-memory
+service for development). Usage:
+
+    python -m fluidframework_tpu.service [--host H] [--port P]
+
+Clients connect with
+``drivers.socket_driver.SocketDocumentServiceFactory`` and the normal
+``loader.Container`` on top.
+"""
+from __future__ import annotations
+
+import argparse
+
+from .ingress import run_server
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        prog="python -m fluidframework_tpu.service",
+        description="fluidframework-tpu dev ordering service",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7070)
+    args = parser.parse_args()
+    run_server(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,283 @@
+"""Networked service ingress — the alfred-equivalent front door.
+
+Reference: the alfred socket handler
+(server/routerlicious/packages/lambdas/src/alfred/index.ts —
+``connect_document`` :465, ``submitOp`` :500) fronting the per-document
+orderer, and the client-side socket protocol
+(packages/drivers/driver-base/src/documentDeltaConnection.ts:41).
+
+Transport: length-prefixed JSON frames (4-byte big-endian length +
+UTF-8 JSON body) over TCP via asyncio — the protocol EVENTS mirror the
+reference's socket.io vocabulary; the framing is deliberately minimal
+(no third-party websocket dependency in this image). Events:
+
+  client -> server
+    {"type": "connect_document", "document_id", "client_id",
+     "details"?}                     -> "connected"
+    {"type": "submitOp", "document_id", "op": {<DocumentMessage>}}
+    {"type": "read_ops", "rid", "document_id", "from_seq", "to_seq"?}
+                                     -> "ops"
+    {"type": "fetch_summary", "rid", "document_id"} -> "summary"
+    {"type": "disconnect_document", "document_id"}
+
+  server -> client
+    {"type": "connected", "document_id", "client_id"}
+    {"type": "op", "document_id", "msg": {<SequencedMessage>}}
+    {"type": "nack", "document_id", ...}
+    {"type": "ops", "rid", "msgs": [...]}
+    {"type": "summary", "rid", "sequence_number", "summary"} | null
+    {"type": "error", "message"}
+
+All orderer work runs on the event loop thread (the deli ticket path is
+synchronous and fast — the C++ batch lane exists for bulk replay);
+per-connection outbound frames go through a queue drained by a writer
+task, so a slow client never blocks sequencing (broadcaster batching,
+lambdas/src/broadcaster/lambda.ts:49).
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import struct
+from typing import Any, Optional
+
+from ..protocol.messages import (
+    ClientDetail,
+    DocumentMessage,
+    MessageType,
+    Nack,
+    SequencedMessage,
+)
+from ..protocol.serialization import (
+    decode_contents,
+    encode_contents,
+    message_from_json,
+    message_to_json,
+)
+from .local_server import DeltaConnection, LocalServer
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 * 1024 * 1024
+
+
+def document_message_to_json(op: DocumentMessage) -> dict:
+    return {
+        "client_sequence_number": op.client_sequence_number,
+        "reference_sequence_number": op.reference_sequence_number,
+        "type": int(op.type),
+        "contents": encode_contents(op.contents),
+        "metadata": op.metadata,
+        "traces": [dataclasses.asdict(t) for t in op.traces],
+    }
+
+
+def document_message_from_json(data: dict) -> DocumentMessage:
+    from ..protocol.messages import Trace
+
+    return DocumentMessage(
+        client_sequence_number=data["client_sequence_number"],
+        reference_sequence_number=data["reference_sequence_number"],
+        type=MessageType(data["type"]),
+        contents=decode_contents(data.get("contents")),
+        metadata=data.get("metadata"),
+        traces=[Trace(**t) for t in data.get("traces", [])],
+    )
+
+
+def nack_to_json(nack: Nack) -> dict:
+    return {
+        "sequence_number": nack.sequence_number,
+        "error_type": int(nack.error_type),
+        "message": nack.message,
+        "retry_after_seconds": nack.retry_after_seconds,
+        "operation": document_message_to_json(nack.operation)
+        if nack.operation is not None else None,
+    }
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    try:
+        header = await reader.readexactly(_LEN.size)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    try:
+        body = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return json.loads(body.decode("utf-8"))
+
+
+def pack_frame(data: dict) -> bytes:
+    body = json.dumps(data).encode("utf-8")
+    return _LEN.pack(len(body)) + body
+
+
+class _ClientSession:
+    """One TCP connection; may hold delta connections to several
+    documents (the reference multiplexes the same way per socket)."""
+
+    def __init__(self, server: "AlfredServer",
+                 writer: asyncio.StreamWriter):
+        self.server = server
+        self.writer = writer
+        self.outbound: asyncio.Queue[Optional[bytes]] = asyncio.Queue()
+        self.connections: dict[str, DeltaConnection] = {}
+
+    def send(self, data: dict) -> None:
+        self.outbound.put_nowait(pack_frame(data))
+
+    async def writer_loop(self) -> None:
+        while True:
+            frame = await self.outbound.get()
+            if frame is None:
+                break
+            try:
+                self.writer.write(frame)
+                await self.writer.drain()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                break
+
+    def close(self) -> None:
+        for conn in self.connections.values():
+            conn.disconnect()
+        self.connections.clear()
+        self.outbound.put_nowait(None)
+
+
+class AlfredServer:
+    """asyncio ingress over a LocalServer (per-document LocalOrderer
+    pipeline — deli/scriptorium/broadcaster/scribe equivalents)."""
+
+    def __init__(self, local: Optional[LocalServer] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.local = local or LocalServer()
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ------------------------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        session = _ClientSession(self, writer)
+        pump = asyncio.ensure_future(session.writer_loop())
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                try:
+                    self._dispatch(session, frame)
+                except Exception as e:  # noqa: BLE001 - report, keep serving
+                    session.send({
+                        "type": "error",
+                        "rid": frame.get("rid"),
+                        "message": f"{type(e).__name__}: {e}",
+                    })
+        finally:
+            session.close()
+            await pump
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    def _dispatch(self, session: _ClientSession, frame: dict) -> None:
+        kind = frame.get("type")
+        doc = frame.get("document_id")
+        if kind == "connect_document":
+            client_id = frame["client_id"]
+            details = frame.get("details") or {}
+            # a retried connect supersedes the old connection: leaving
+            # it joined would pin the document's msn at its refSeq and
+            # double-deliver every op to this session
+            stale = session.connections.pop(doc, None)
+            if stale is not None:
+                stale.disconnect()
+            conn = self.local.connect(
+                doc, client_id,
+                on_message=lambda msg, d=doc: session.send({
+                    "type": "op", "document_id": d,
+                    "msg": message_to_json(msg),
+                }),
+                on_nack=lambda nack, d=doc: session.send({
+                    "type": "nack", "document_id": d,
+                    **nack_to_json(nack),
+                }),
+                detail=ClientDetail(client_id, **details)
+                if details else None,
+            )
+            session.connections[doc] = conn
+            session.send({
+                "type": "connected", "document_id": doc,
+                "client_id": client_id,
+            })
+        elif kind == "submitOp":
+            conn = session.connections[doc]
+            conn.submit(document_message_from_json(frame["op"]))
+        elif kind == "read_ops":
+            msgs = self.local.read_ops(
+                doc, frame["from_seq"], frame.get("to_seq")
+            )
+            session.send({
+                "type": "ops", "rid": frame.get("rid"),
+                "msgs": [message_to_json(m) for m in msgs],
+            })
+        elif kind == "fetch_summary":
+            latest = self.local.latest_summary(doc)
+            payload: dict[str, Any] = {
+                "type": "summary", "rid": frame.get("rid"),
+            }
+            if latest is None:
+                payload["sequence_number"] = None
+                payload["summary"] = None
+            else:
+                payload["sequence_number"] = latest.sequence_number
+                payload["summary"] = encode_contents(latest.summary)
+            session.send(payload)
+        elif kind == "disconnect_document":
+            conn = session.connections.pop(doc, None)
+            if conn is not None:
+                conn.disconnect()
+        else:
+            raise ValueError(f"unknown frame type {kind!r}")
+
+
+def run_server(host: str = "127.0.0.1", port: int = 7070) -> None:
+    """Blocking entry point (the tinylicious analogue; see
+    service/__main__.py)."""
+    server = AlfredServer(host=host, port=port)
+
+    async def main():
+        await server.start()
+        print(f"fluidframework-tpu dev service listening on "
+              f"{server.host}:{server.port}", flush=True)
+        await server.serve_forever()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:  # pragma: no cover - interactive
+        pass

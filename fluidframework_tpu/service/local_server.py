@@ -69,6 +69,7 @@ class LocalServer:
     def connect(self, document_id: str, client_id: str,
                 on_message: Callable[[SequencedMessage], None],
                 on_nack: Optional[Callable[[Nack], None]] = None,
+                detail: Optional[ClientDetail] = None,
                 ) -> DeltaConnection:
         orderer = self.get_orderer(document_id)
         connection_id = f"conn-{next(self._conn_counter)}"
@@ -80,7 +81,7 @@ class LocalServer:
             connection_id, lambda msg: conn.on_message and
             conn.on_message(msg)
         )
-        orderer.connect(ClientDetail(client_id))
+        orderer.connect(detail or ClientDetail(client_id))
         return conn
 
     # ------------------------------------------------------------------
