@@ -61,6 +61,12 @@ class Container(EventEmitter):
         self._connection = None
         self._csn = 0
         self.closed = False
+        # incremental-summary bookkeeping: per-channel change counts
+        # captured at submit, promoted on the matching summaryAck
+        self._acked_summary_counts: Optional[dict] = None
+        self._pending_summary_counts: Optional[dict] = None
+        self._pending_summary_seq: Optional[int] = None
+        self._pending_summary_csn: Optional[int] = None
         # feature gates read ad hoc from config (config.ts pattern,
         # e.g. containerRuntime.ts:1704)
         compression_min = self.mc.config.get_number("compressionMinSize")
@@ -247,7 +253,26 @@ class Container(EventEmitter):
             self.runtime.process(msg)
         else:
             self.runtime.observe_system(msg)
+            if (
+                msg.type == MessageType.SUMMARIZE
+                and msg.client_id == self.client_id
+                and msg.client_sequence_number ==
+                self._pending_summary_csn
+            ):
+                # our summarize op sequenced: remember its proposal seq
+                # so the matching ack promotes the captured counts
+                self._pending_summary_seq = msg.sequence_number
             if msg.type == MessageType.SUMMARY_ACK:
+                proposal = (msg.contents or {}).get("summaryProposal")
+                if (
+                    self._pending_summary_seq is not None
+                    and proposal == self._pending_summary_seq
+                ):
+                    self._acked_summary_counts = \
+                        self._pending_summary_counts
+                    self._pending_summary_counts = None
+                    self._pending_summary_seq = None
+                    self._pending_summary_csn = None
                 self.emit("summaryAck", msg.contents)
             elif msg.type == MessageType.SUMMARY_NACK:
                 self.emit("summaryNack", msg.contents)
@@ -318,19 +343,34 @@ class Container(EventEmitter):
     # ------------------------------------------------------------------
     # summarization (client half of §3.4)
 
-    def summarize(self) -> dict:
+    def summarize(self, incremental: bool = False) -> dict:
         """Produce and submit a summary; the service (scribe) acks it.
-        Requires a quiescent runtime (no pending local ops)."""
+        Requires a quiescent runtime (no pending local ops).
+
+        ``incremental=True`` replaces every channel that is unchanged
+        since this container's last ACKED summary with a
+        SummaryType.Handle node (summary.ts:55-59); the service
+        storage expands handles against the stored previous version,
+        so an unchanged channel costs neither serialization here nor
+        new objects there."""
         self.flush()
         assert self.runtime.pending.count == 0, (
             "summarize with in-flight local ops"
         )
+        unchanged: frozenset = frozenset()
+        if incremental and self._acked_summary_counts is not None:
+            unchanged = frozenset(
+                key for key, count in self._channel_counts().items()
+                if self._acked_summary_counts.get(key) == count
+            )
         summary = {
             "protocol": self.protocol.snapshot(),
-            "runtime": self.runtime.summarize(),
+            "runtime": self.runtime.summarize(unchanged),
         }
         if self.connected:
             self._csn += 1
+            self._pending_summary_counts = self._channel_counts()
+            self._pending_summary_csn = self._csn
             self._connection.submit(DocumentMessage(
                 client_sequence_number=self._csn,
                 reference_sequence_number=self.last_processed_seq,
@@ -341,3 +381,10 @@ class Container(EventEmitter):
                 },
             ))
         return summary
+
+    def _channel_counts(self) -> dict:
+        return {
+            (ds_id, cid): ch.change_count
+            for ds_id, ds in self.runtime.datastores.items()
+            for cid, ch in ds.channels.items()
+        }

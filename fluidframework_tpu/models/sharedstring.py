@@ -18,6 +18,9 @@ from .mergetree import MergeTreeClient
 from .mergetree.segments import Segment
 
 
+SNAPSHOT_CHUNK_SEGMENTS = 512
+
+
 class SharedString(SharedObject, EventEmitter):
     type_name = "sharedstring"
 
@@ -221,8 +224,18 @@ class SharedString(SharedObject, EventEmitter):
                     if seg.attribution is not None else None
                 ),
             })
+        # Chunked snapshot format v2 (snapshotV1.ts:36 +
+        # snapshotChunks.ts): fixed-size segment chunks so the
+        # content-addressed store re-uses every unchanged chunk of an
+        # append-mostly document; "format" guards compat (format
+        # changes must keep load_core accepting all published values).
+        chunks = [
+            segments[i : i + SNAPSHOT_CHUNK_SEGMENTS]
+            for i in range(0, len(segments), SNAPSHOT_CHUNK_SEGMENTS)
+        ] or [[]]
         return {
-            "segments": segments,
+            "format": 2,
+            "chunks": chunks,
             "minSeq": tree.collab.min_seq,
             "currentSeq": tree.collab.current_seq,
             "intervals": {
@@ -237,7 +250,11 @@ class SharedString(SharedObject, EventEmitter):
         assert not tree.segments, "load into non-empty string"
         tree.collab.min_seq = summary["minSeq"]
         tree.collab.current_seq = summary["currentSeq"]
-        for entry in summary["segments"]:
+        if "chunks" in summary:  # format 2
+            entries = [e for chunk in summary["chunks"] for e in chunk]
+        else:  # format 1 (flat list) — still loadable
+            entries = summary["segments"]
+        for entry in entries:
             seg = Segment(
                 text=entry["text"],
                 marker=entry["marker"],

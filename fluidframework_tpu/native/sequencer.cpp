@@ -70,8 +70,10 @@ int64_t seq_client_join(void* handle, int64_t client_id) {
     int64_t join_seq = ++s->seq;
     auto it = s->clients.find(client_id);
     if (it == s->clients.end()) {
-        s->clients[client_id] = ClientState{join_seq, 0};
-        s->ref_seqs.insert(join_seq);
+        // refSeq starts at the seq BEFORE the join: the client has
+        // not seen its own join yet (matches service/sequencer.py)
+        s->clients[client_id] = ClientState{join_seq - 1, 0};
+        s->ref_seqs.insert(join_seq - 1);
     }
     s->compute_msn();
     return join_seq;

@@ -45,10 +45,11 @@ def apply_window_impl(table: SegmentTable, batch: OpBatch) -> SegmentTable:
     """XLA executor: scan the fused step over the [docs, window] batch.
     Pure/jittable; doc axis shards cleanly under shard_map.
 
-    unroll=16 on TPU: the axon runtime charges ~0.3ms per kernel
+    unroll=4 on TPU: the axon runtime charges ~0.3ms per kernel
     launch, so per-step launch overhead dominates the window (measured
-    2.35 -> 1.35 ms/step at 1024x512); unrolling fuses launches across
-    steps. Kept at 1 elsewhere — CPU tests would only pay 16x compile.
+    2.35 -> 1.52 ms/step at 1024x512; unroll 16 was marginally faster
+    at 1.35 but ballooned remote compiles past the bench timeout).
+    Kept at 1 elsewhere — CPU tests would only pay extra compile.
     """
     st = table_to_state(table)
     ops_wd = {
@@ -59,7 +60,7 @@ def apply_window_impl(table: SegmentTable, batch: OpBatch) -> SegmentTable:
     def step(carry, op):
         return fused_step(carry, op), None
 
-    unroll = 16 if jax.default_backend() == "tpu" else 1
+    unroll = 4 if jax.default_backend() == "tpu" else 1
     st, _ = jax.lax.scan(step, st, ops_wd, unroll=unroll)
     return state_to_table(st, SegmentTable)
 
@@ -74,11 +75,12 @@ _apply_window_xla = jax.jit(apply_window_impl)
 
 def _use_pallas(table: SegmentTable) -> bool:
     # Opt-in (FFTPU_PALLAS=1): the Mosaic kernel is correctness-proven
-    # on-chip but the XLA scan currently wins on throughput (26M vs
-    # ~6M ops/s at 1024x1024 — the scan pipelines HBM traffic across
-    # steps, while the VMEM-resident kernel is VPU-bound on ~150
-    # vector ops x capacity lanes per op). Revisit with the two-level
-    # blocked layout (per-128-slot partial sums) before making default.
+    # on-chip but the XLA scan currently wins on throughput
+    # (transfer-forced: 0.84M vs 0.31M ops/s at 1024x1024x201 —
+    # Mosaic's lane-reduce codegen makes the VMEM-resident body
+    # VPU-bound far above its theoretical cost). Revisit with the
+    # two-level blocked layout (per-128-slot partial sums) before
+    # making this the default.
     if os.environ.get("FFTPU_PALLAS") != "1":
         return False
     if table.capacity % 128 != 0:

@@ -334,10 +334,16 @@ class ContainerRuntime(EventEmitter):
     # ------------------------------------------------------------------
     # summary (§3.4 client side)
 
-    def summarize(self) -> dict:
+    def summarize(self, unchanged: frozenset = frozenset()) -> dict:
+        """``unchanged``: (datastore_id, channel_id) pairs to emit as
+        summary handles instead of re-serializing (incremental
+        summaries — the container tracks which channels are unchanged
+        since the last ACKED summary)."""
         out = {
             "datastores": {
-                ds_id: ds.summarize()
+                ds_id: ds.summarize(frozenset(
+                    cid for d, cid in unchanged if d == ds_id
+                ))
                 for ds_id, ds in self.datastores.items()
             },
             "blobs": self.blobs.summarize(),

@@ -106,19 +106,33 @@ class DataStoreRuntime:
             metadata=msg.metadata,
             timestamp=msg.timestamp,
         )
+        if not local:
+            # remote edits dirty the channel (local ones were counted
+            # at submit time)
+            channel.change_count += 1
         channel.process_core(inner, local, local_op_metadata)
 
     # ------------------------------------------------------------------
     # summary
 
-    def summarize(self) -> dict:
+    def summarize(self, skip_channels: frozenset = frozenset()
+                  ) -> dict:
+        """``skip_channels``: channel ids whose serialization is
+        skipped in favor of a summary handle into the previous acked
+        summary — the incremental path (SummaryType.Handle); the
+        service storage expands them (service/storage.py)."""
         return {
             "root": self.root,
             "channels": {
-                cid: {
-                    "type": ch.type_name,
-                    "content": ch.summarize_core(),
-                }
+                cid: (
+                    {"__summary_handle__":
+                     f"runtime/datastores/{self.id}/channels/{cid}"}
+                    if cid in skip_channels else
+                    {
+                        "type": ch.type_name,
+                        "content": ch.summarize_core(),
+                    }
+                )
                 for cid, ch in self.channels.items()
             },
         }

@@ -38,6 +38,11 @@ class SharedObject(abc.ABC):
     def __init__(self, channel_id: str):
         self.id = channel_id
         self._services: Optional[ChannelServices] = None
+        # monotonic edit counter driving incremental summaries: a
+        # channel whose count equals its last-ACKED-summary capture is
+        # unchanged and summarizes as a SummaryType.Handle
+        # (summary.ts:55-59)
+        self.change_count = 0
 
     # ------------------------------------------------------------------
     # wiring
@@ -62,6 +67,7 @@ class SharedObject(abc.ABC):
                              metadata: Any = None) -> None:
         """sharedObject.ts:343 — route a local op to the service via
         the runtime; detached objects apply locally only."""
+        self.change_count += 1
         if self._services is not None:
             self._services.submit(contents, metadata)
 

@@ -23,8 +23,11 @@ def main() -> None:
     )
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=7070)
+    parser.add_argument("--data-dir", default=None,
+                        help="durable storage root (op logs, "
+                             "summaries, checkpoints)")
     args = parser.parse_args()
-    run_server(args.host, args.port)
+    run_server(args.host, args.port, args.data_dir)
 
 
 if __name__ == "__main__":

@@ -266,10 +266,14 @@ class AlfredServer:
             raise ValueError(f"unknown frame type {kind!r}")
 
 
-def run_server(host: str = "127.0.0.1", port: int = 7070) -> None:
+def run_server(host: str = "127.0.0.1", port: int = 7070,
+               data_dir: Optional[str] = None) -> None:
     """Blocking entry point (the tinylicious analogue; see
-    service/__main__.py)."""
-    server = AlfredServer(host=host, port=port)
+    service/__main__.py). ``data_dir`` makes every document durable:
+    op log, summaries and deli checkpoints survive restarts."""
+    server = AlfredServer(
+        LocalServer(durable_dir=data_dir), host=host, port=port
+    )
 
     async def main():
         await server.start()

@@ -37,6 +37,7 @@ class OpLog:
                 "op log must stay contiguous"
             )
         self._ops.append(msg)
+        self._persist_append(msg)
 
     def read(self, from_seq: int, to_seq: Optional[int] = None
              ) -> list[SequencedMessage]:
@@ -56,7 +57,18 @@ class OpLog:
         deli/lambda.ts:342 area). Returns dropped count."""
         before = len(self._ops)
         self._ops = [m for m in self._ops if m.sequence_number > seq]
-        return before - len(self._ops)
+        dropped = before - len(self._ops)
+        if dropped:
+            self._persist_truncate()
+        return dropped
+
+    # durability hooks (FileOpLog overrides; mirror of
+    # ContentStore._store/_load)
+    def _persist_append(self, msg: SequencedMessage) -> None:
+        pass
+
+    def _persist_truncate(self) -> None:
+        pass
 
     @property
     def last_seq(self) -> int:
@@ -102,18 +114,49 @@ class ServiceSummary:
 
 
 class SummaryStore:
-    """The git-storage stand-in (historian/gitrest): versioned summary
-    blobs per document."""
+    """Versioned summary storage (historian/gitrest facade): summaries
+    are split into content-addressed subtree objects, incremental
+    {"__summary_handle__": path} nodes are resolved against the
+    previous version (SummaryType.Handle, summary.ts:55-59), and an
+    unchanged subtree costs zero new objects. In-memory by default; a
+    ``DocumentStorage`` backend makes it durable on disk."""
 
-    def __init__(self) -> None:
-        self.versions: list[ServiceSummary] = []
+    def __init__(self, storage=None) -> None:
+        from .storage import SummaryTreeStore
 
-    def write(self, sequence_number: int, summary: dict) -> int:
-        self.versions.append(ServiceSummary(sequence_number, summary))
-        return len(self.versions) - 1
+        self._storage = storage
+        if storage is not None:
+            self._trees = storage.trees
+            self._roots = [
+                (v.sequence_number, v.root) for v in storage.versions
+            ]
+        else:
+            self._trees = SummaryTreeStore()
+            self._roots: list[tuple[int, str]] = []
+
+    def write(self, sequence_number: int, summary: dict) -> str:
+        """Store a summary (resolving handles); returns the root sha —
+        the ack handle clients see (summaryAck.handle)."""
+        if self._storage is not None:
+            root = self._storage.write_summary(sequence_number, summary)
+        else:
+            prev = self._roots[-1][1] if self._roots else None
+            root = self._trees.write(summary, previous_root=prev)
+        self._roots.append((sequence_number, root))
+        return root
 
     def latest(self) -> Optional[ServiceSummary]:
-        return self.versions[-1] if self.versions else None
+        if not self._roots:
+            return None
+        seq, root = self._roots[-1]
+        return ServiceSummary(seq, self._trees.read(root))
+
+    @property
+    def version_count(self) -> int:
+        return len(self._roots)
+
+    def object_count(self) -> int:
+        return self._trees.store.object_count()
 
 
 class ScribeLambda:

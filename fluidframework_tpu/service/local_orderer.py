@@ -31,14 +31,16 @@ from .sequencer import DocumentSequencer
 class LocalOrderer:
     """One document's ordering service instance."""
 
-    def __init__(self, document_id: str, lumberjack=None):
+    def __init__(self, document_id: str, lumberjack=None,
+                 storage=None, checkpoint_every: int = 1):
         import os
 
         from .telemetry import Lumberjack
         self.document_id = document_id
         self.lumberjack = lumberjack or Lumberjack()
-        self.op_log = OpLog()
-        self.summary_store = SummaryStore()
+        self.storage = storage
+        self.op_log = storage.op_log if storage is not None else OpLog()
+        self.summary_store = SummaryStore(storage)
         self.sequencer = DocumentSequencer(document_id)
         if os.environ.get("FFTPU_NATIVE_SEQUENCER") == "1":
             try:
@@ -46,6 +48,8 @@ class LocalOrderer:
                 self.sequencer = NativeSequencerCore(document_id)
             except (RuntimeError, OSError):
                 pass  # toolchain unavailable: Python path stands in
+        self._checkpoint_every = checkpoint_every
+        self._since_checkpoint = 0
         self.scriptorium = ScriptoriumLambda(self.op_log)
         self.broadcaster = BroadcasterLambda()
         self.scribe = ScribeLambda(
@@ -63,6 +67,23 @@ class LocalOrderer:
         # finishes (LocalKafka's async delivery, memory-orderer).
         self._dispatch_queue: deque[SequencedMessage] = deque()
         self._dispatching = False
+        if storage is not None:
+            state = storage.read_checkpoint()
+            if state is not None:
+                self.restore(state)
+            # ops sequenced after the last checkpoint write (or with a
+            # lost/absent checkpoint entirely) are in the durable log;
+            # fast-forward the stream position so new tickets continue
+            # the contiguous order
+            gap = self.op_log.last_seq - self.sequencer.sequence_number
+            for _ in range(max(0, gap)):
+                self.sequencer.system_message(MessageType.NO_OP, None)
+            # every pre-crash connection is gone: sequence leaves for
+            # the checkpointed clients so (a) their stale csn state
+            # cannot silently swallow a reconnecting client's ops as
+            # duplicates, and (b) their refSeqs stop pinning the msn
+            for cid in list(self.sequencer.clients):
+                self.disconnect(cid)
 
     # ------------------------------------------------------------------
     # ingress (alfred submitOp path)
@@ -111,8 +132,15 @@ class LocalOrderer:
                 current = self._dispatch_queue.popleft()
                 for stage in self._pipeline:
                     stage(current)
+                self._since_checkpoint += 1
         finally:
             self._dispatching = False
+        if (
+            self.storage is not None
+            and self._since_checkpoint >= self._checkpoint_every
+        ):
+            self._since_checkpoint = 0
+            self.storage.write_checkpoint(self.checkpoint())
 
     # ------------------------------------------------------------------
     # checkpoint/resume (deli/checkpointContext.ts + scribe state)

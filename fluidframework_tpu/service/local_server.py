@@ -53,13 +53,25 @@ class LocalServer:
     """Multi-document service: one LocalOrderer per document
     (document-parallelism — SURVEY §2.9 axis 1)."""
 
-    def __init__(self) -> None:
+    def __init__(self, durable_dir: Optional[str] = None) -> None:
         self.documents: dict[str, LocalOrderer] = {}
+        self.durable_dir = durable_dir
         self._conn_counter = itertools.count()
 
     def get_orderer(self, document_id: str) -> LocalOrderer:
         if document_id not in self.documents:
-            self.documents[document_id] = LocalOrderer(document_id)
+            storage = None
+            if self.durable_dir is not None:
+                import os
+
+                from .storage import DocumentStorage
+
+                storage = DocumentStorage(
+                    os.path.join(self.durable_dir, document_id)
+                )
+            self.documents[document_id] = LocalOrderer(
+                document_id, storage=storage
+            )
         return self.documents[document_id]
 
     # ------------------------------------------------------------------

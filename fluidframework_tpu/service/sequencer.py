@@ -83,13 +83,19 @@ class DocumentSequencer:
     def client_join(self, detail: ClientDetail) -> SequencedMessage:
         """Server-generated join (alfred connect_document ->
         deli; lambdas/src/alfred/index.ts:465). The new client's refSeq
-        starts at the seq of the join op itself."""
+        starts at the seq BEFORE its join: the join itself hasn't
+        reached the client yet, so crediting it with the join's seq
+        lets the msn outrun what the client has provably processed —
+        its first op (submitted before the join broadcast arrives over
+        a real network) would then nack with 'refSeq below msn'
+        (found by tools/net_stress over TCP; in-proc delivery is
+        synchronous and never exposed the race)."""
         seq = self._next_seq()
         existing = self._clients.get(detail.client_id)
         if existing is None:
             self._clients[detail.client_id] = _ClientState(
                 client_id=detail.client_id,
-                reference_sequence_number=seq,
+                reference_sequence_number=seq - 1,
             )
         # A redundant join (at-least-once ingress retry) must NOT reset
         # sequencing state, or replayed ops would be re-ticketed as new.

@@ -47,7 +47,10 @@ def worker_main(host: str, port: int, document_id: str,
     from ..loader import Container
 
     svc = SocketDocumentService(host, port, document_id)
-    container = Container.load(svc, client_id=client_id)
+    # the dispatch thread mutates the container under svc.lock; load
+    # (connect, channel collab renames) must hold it too
+    with svc.lock:
+        container = Container.load(svc, client_id=client_id)
     rng = random.Random(seed)
     alphabet = "abcdefghijklmnopqrstuvwxyz"
 
@@ -190,7 +193,8 @@ def run_net_stress(n_workers: int = 3, n_ops: int = 30,
         from ..loader import Container
 
         svc = SocketDocumentService("127.0.0.1", port, "stress-doc")
-        validator = Container.load(svc, client_id="validator")
+        with svc.lock:
+            validator = Container.load(svc, client_id="validator")
         with svc.lock:
             replay_text = (validator.runtime.get_datastore("stress")
                            .get_channel("text").get_text())

@@ -1,0 +1,277 @@
+"""Durable storage plane: content-addressed summary trees, incremental
+handle summaries, chunked snapshots, persisted op log + checkpoints,
+and kill-and-restart resume across a real process boundary.
+
+Reference parity: historian/gitrest (content-addressed summary
+storage), SummaryType.Handle incremental summaries (summary.ts:55-59),
+chunked merge-tree snapshots (snapshotV1.ts:36, snapshotChunks.ts),
+scriptorium's durable op log, deli checkpoint/restore
+(deli/checkpointContext.ts).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.service.local_server import LocalServer
+from fluidframework_tpu.service.storage import (
+    ContentStore,
+    DocumentStorage,
+    FileContentStore,
+    SummaryTreeStore,
+)
+
+
+# ----------------------------------------------------------------------
+# content-addressed tree store
+
+def test_tree_store_roundtrip_and_dedup():
+    store = SummaryTreeStore(ContentStore())
+    summary = {
+        "protocol": {"members": ["a", "b"]},
+        "runtime": {
+            "datastores": {
+                "d": {"root": True, "channels": {
+                    "t": {"type": "sharedstring",
+                          "content": {"chunks": [[1, 2], [3]]}},
+                }},
+            },
+            "blobs": {},
+        },
+    }
+    root1 = store.write(summary)
+    assert store.read(root1) == summary
+    n1 = store.store.object_count()
+    # identical summary: zero new objects
+    root2 = store.write(summary)
+    assert root2 == root1
+    assert store.store.object_count() == n1
+    # change one channel chunk: only the changed path writes objects
+    summary2 = json.loads(json.dumps(summary))
+    summary2["runtime"]["datastores"]["d"]["channels"]["t"][
+        "content"]["chunks"][1] = [3, 4]
+    root3 = store.write(summary2, previous_root=root1)
+    delta = store.store.object_count() - n1
+    assert root3 != root1
+    assert delta <= 8  # changed chunk + spine rewrite, not O(tree)
+    # the chunk split must actually engage (a regression at depth 5
+    # stored the whole multi-chunk snapshot as one blob)
+    assert any(
+        b"__chunklist__" in store.store._load(sha)
+        for sha in store.store._objects
+    )
+    # unchanged chunk [1, 2] was reused: exactly one object holds it
+    chunk_sha = store.store.put([1, 2])  # idempotent: already there
+    assert store.store.has(chunk_sha)
+
+
+def test_tree_store_handle_resolution():
+    store = SummaryTreeStore(ContentStore())
+    v1 = {"runtime": {"datastores": {"d": {"channels": {
+        "t": {"type": "x", "content": {"v": 1}},
+    }}}}}
+    root1 = store.write(v1)
+    v2 = {"runtime": {"datastores": {"d": {"channels": {
+        "t": {"__summary_handle__": "runtime/datastores/d/channels/t"},
+    }}}}}
+    root2 = store.write(v2, previous_root=root1)
+    assert store.read(root2) == v1
+    with pytest.raises(ValueError):
+        store.write(v2, previous_root=None)
+
+
+def test_file_content_store_persists(tmp_path):
+    root = str(tmp_path / "store")
+    s1 = FileContentStore(root)
+    sha = s1.put({"hello": [1, 2, 3]})
+    s2 = FileContentStore(root)  # fresh instance, same dir
+    assert s2.has(sha)
+    assert s2.get(sha) == {"hello": [1, 2, 3]}
+
+
+# ----------------------------------------------------------------------
+# incremental summaries end to end (client handles -> store expansion)
+
+def _mk_pair(server):
+    factory = LocalDocumentServiceFactory(server)
+    a = Container.load(factory.create_document_service("doc"),
+                       client_id="alice")
+    text = (a.runtime.create_datastore("d")
+            .create_channel("sharedstring", "t"))
+    other = a.runtime.get_datastore("d").create_channel(
+        "sharedmap", "m"
+    )
+    a.flush()
+    return a, text, other
+
+
+def test_incremental_summary_unchanged_channel_is_handle():
+    server = LocalServer()
+    a, text, other = _mk_pair(server)
+    text.insert_text(0, "hello world")
+    other.set("k", 1)
+    a.flush()
+    a.summarize()  # full; ack arrives synchronously via local orderer
+
+    # edit ONLY the map; the string must summarize as a handle
+    other.set("k", 2)
+    a.flush()
+    summary = a.summarize(incremental=True)
+    channels = summary["runtime"]["datastores"]["d"]["channels"]
+    assert "__summary_handle__" in channels["t"]
+    assert "content" in channels["m"]
+
+    # the stored (expanded) version still loads with full content
+    latest = server.get_orderer("doc").summary_store.latest()
+    stored = latest.summary["summary"] if "summary" in latest.summary \
+        else latest.summary
+    chans = stored["runtime"]["datastores"]["d"]["channels"]
+    assert chans["t"]["type"] == "sharedstring"
+    b = Container.load(
+        LocalDocumentServiceFactory(server)
+        .create_document_service("doc"),
+        client_id="bob",
+    )
+    tb = b.runtime.get_datastore("d").get_channel("t")
+    assert tb.get_text() == "hello world"
+    assert b.runtime.get_datastore("d").get_channel("m").get("k") == 2
+
+
+def test_second_summary_of_unchanged_container_is_cheap():
+    server = LocalServer()
+    a, text, other = _mk_pair(server)
+    text.insert_text(0, "stable content " * 50)
+    a.flush()
+    a.summarize()
+    store = server.get_orderer("doc").summary_store
+    n1 = store.object_count()
+    # nothing changed except the collab window advancing via the
+    # summarize op itself; the second incremental summary should write
+    # O(1) new objects, not re-store every channel
+    a.summarize(incremental=True)
+    assert store.version_count == 2
+    assert store.object_count() - n1 <= 10
+
+
+def test_chunked_snapshot_roundtrip():
+    server = LocalServer()
+    a, text, _ = _mk_pair(server)
+    from fluidframework_tpu.models import sharedstring as ss_mod
+
+    # force multiple chunks with a small chunk size
+    orig = ss_mod.SNAPSHOT_CHUNK_SEGMENTS
+    ss_mod.SNAPSHOT_CHUNK_SEGMENTS = 4
+    try:
+        for i in range(30):
+            text.insert_text(0, f"w{i} ")
+        a.flush()
+        summary = text.summarize_core()
+        assert summary["format"] == 2
+        assert len(summary["chunks"]) > 1
+        clone = type(text)("t2")
+        clone.load_core(summary)
+        assert clone.get_text() == text.get_text()
+        # format-1 (flat) snapshots must still load
+        flat = {
+            "segments": [e for c in summary["chunks"] for e in c],
+            "minSeq": summary["minSeq"],
+            "currentSeq": summary["currentSeq"],
+            "intervals": {},
+        }
+        clone2 = type(text)("t3")
+        clone2.load_core(flat)
+        assert clone2.get_text() == text.get_text()
+    finally:
+        ss_mod.SNAPSHOT_CHUNK_SEGMENTS = orig
+
+
+# ----------------------------------------------------------------------
+# durable op log + checkpoint across a REAL process restart
+
+def _run_worker(port, client_id, action):
+    code = (
+        "import sys; sys.path.insert(0, '.')\n"
+        "from fluidframework_tpu.drivers.socket_driver import "
+        "SocketDocumentService\n"
+        "from fluidframework_tpu.loader import Container\n"
+        f"svc = SocketDocumentService('127.0.0.1', {port}, 'dur-doc')\n"
+        "with svc.lock:\n"
+        f"    c = Container.load(svc, client_id={client_id!r})\n"
+        "with svc.lock:\n"
+        + action +
+        "\nimport time\n"
+        "deadline = time.time() + 30\n"
+        "while time.time() < deadline:\n"
+        "    with svc.lock:\n"
+        "        if c.runtime.pending.count == 0: break\n"
+        "    time.sleep(0.02)\n"
+        "else:\n"
+        "    raise TimeoutError('ops never acked')\n"
+        "c.close(); svc.close()\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_service_survives_kill_and_restart(tmp_path):
+    """VERDICT r3 #5 done-criterion: the service resumes from durable
+    state across a process restart (SIGKILL, no graceful shutdown)."""
+    import re
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    data_dir = str(tmp_path / "data")
+
+    def start_server():
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "fluidframework_tpu.service",
+             "--port", "0", "--data-dir", data_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=repo,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        line = proc.stdout.readline()
+        m = re.search(r"listening on [\w.]+:(\d+)", line)
+        assert m, line
+        return proc, int(m.group(1))
+
+    server, port = start_server()
+    try:
+        _run_worker(port, "alice", (
+            "    t = c.runtime.create_datastore('d')"
+            ".create_channel('sharedstring', 't')\n"
+            "    c.flush()\n"
+            "    t.insert_text(0, 'before the crash')\n"
+            "    c.flush()\n"
+        ))
+    finally:
+        os.kill(server.pid, signal.SIGKILL)
+        server.wait()
+
+    # restart over the same data dir: op log + checkpoint reload
+    server, port = start_server()
+    try:
+        out = _run_worker(port, "bob", (
+            "    t = c.runtime.get_datastore('d').get_channel('t')\n"
+            "    print('TEXT=' + t.get_text())\n"
+            "    t.insert_text(0, 'after: ')\n"
+            "    c.flush()\n"
+            "    print('FINAL=' + t.get_text())\n"
+        ))
+        assert "TEXT=before the crash" in out
+        assert "FINAL=after: before the crash" in out
+    finally:
+        server.kill()
+        server.wait()

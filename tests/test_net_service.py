@@ -60,7 +60,8 @@ def server():
 def test_two_clients_converge_over_tcp(server):
     sa = SocketDocumentService("127.0.0.1", server.port, "doc")
     sb = SocketDocumentService("127.0.0.1", server.port, "doc")
-    a = Container.load(sa, client_id="alice")
+    with sa.lock:
+        a = Container.load(sa, client_id="alice")
     with sa.lock:
         ta = (a.runtime.create_datastore("d")
               .create_channel("sharedstring", "t"))
@@ -68,8 +69,8 @@ def test_two_clients_converge_over_tcp(server):
         ta.insert_text(0, "hello")
         a.flush()
 
-    b = Container.load(sb, client_id="bob")
     with sb.lock:
+        b = Container.load(sb, client_id="bob")
         tb = b.runtime.get_datastore("d").get_channel("t")
         assert tb.get_text() == "hello"
         tb.insert_text(5, " world")
