@@ -357,10 +357,11 @@ class Container(EventEmitter):
         assert self.runtime.pending.count == 0, (
             "summarize with in-flight local ops"
         )
+        counts = self._channel_counts()
         unchanged: frozenset = frozenset()
         if incremental and self._acked_summary_counts is not None:
             unchanged = frozenset(
-                key for key, count in self._channel_counts().items()
+                key for key, count in counts.items()
                 if self._acked_summary_counts.get(key) == count
             )
         summary = {
@@ -369,7 +370,7 @@ class Container(EventEmitter):
         }
         if self.connected:
             self._csn += 1
-            self._pending_summary_counts = self._channel_counts()
+            self._pending_summary_counts = counts
             self._pending_summary_csn = self._csn
             self._connection.submit(DocumentMessage(
                 client_sequence_number=self._csn,

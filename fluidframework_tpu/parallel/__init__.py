@@ -3,6 +3,7 @@ mesh (the reference's Kafka-partition axis, SURVEY §2.9)."""
 from .mesh import (
     DOC_AXIS,
     doc_sharding,
+    global_window_floor,
     make_mesh,
     scalar_sharding,
     shard_pytree,
@@ -11,6 +12,7 @@ from .mesh import (
 __all__ = [
     "DOC_AXIS",
     "doc_sharding",
+    "global_window_floor",
     "make_mesh",
     "scalar_sharding",
     "shard_pytree",

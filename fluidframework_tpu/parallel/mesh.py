@@ -36,3 +36,26 @@ def shard_pytree(tree, mesh: Mesh):
     """Place every leaf with dim 0 = docs on the doc axis."""
     sharding = doc_sharding(mesh)
     return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
+
+
+def global_window_floor(min_seq, mesh: Mesh):
+    """Cross-device collab-window reduction: the global minimum msn
+    over every document shard, computed with a real ICI collective
+    (``lax.pmin`` under shard_map) and replicated to all devices.
+
+    Service analogue: aggregating deli's per-partition
+    durableSequenceNumber into a service-wide durable floor (the op
+    log can truncate at/below it across every partition —
+    deli/lambda.ts:342 area, kafka-service checkpointManager.ts:10).
+    This is the mesh's first non-embarrassingly-parallel operation:
+    doc shards are otherwise independent vmap lanes.
+    """
+    import jax.numpy as jnp
+    from jax import shard_map
+
+    def local(ms):  # [docs_shard] on each device
+        return jax.lax.pmin(jnp.min(ms), DOC_AXIS)
+
+    return shard_map(
+        local, mesh=mesh, in_specs=P(DOC_AXIS), out_specs=P(),
+    )(min_seq)

@@ -127,28 +127,35 @@ class SummaryStore:
         self._storage = storage
         if storage is not None:
             self._trees = storage.trees
-            self._roots = [
-                (v.sequence_number, v.root) for v in storage.versions
-            ]
+            self._mem_roots = None  # storage.versions is canonical
         else:
             self._trees = SummaryTreeStore()
-            self._roots: list[tuple[int, str]] = []
+            self._mem_roots: Optional[list[tuple[int, str]]] = []
+
+    @property
+    def _roots(self) -> list[tuple[int, str]]:
+        if self._storage is not None:
+            return [
+                (v.sequence_number, v.root)
+                for v in self._storage.versions
+            ]
+        return self._mem_roots
 
     def write(self, sequence_number: int, summary: dict) -> str:
         """Store a summary (resolving handles); returns the root sha —
         the ack handle clients see (summaryAck.handle)."""
         if self._storage is not None:
-            root = self._storage.write_summary(sequence_number, summary)
-        else:
-            prev = self._roots[-1][1] if self._roots else None
-            root = self._trees.write(summary, previous_root=prev)
-        self._roots.append((sequence_number, root))
+            return self._storage.write_summary(sequence_number, summary)
+        prev = self._mem_roots[-1][1] if self._mem_roots else None
+        root = self._trees.write(summary, previous_root=prev)
+        self._mem_roots.append((sequence_number, root))
         return root
 
     def latest(self) -> Optional[ServiceSummary]:
-        if not self._roots:
+        roots = self._roots
+        if not roots:
             return None
-        seq, root = self._roots[-1]
+        seq, root = roots[-1]
         return ServiceSummary(seq, self._trees.read(root))
 
     @property

@@ -78,6 +78,16 @@ class LocalOrderer:
             gap = self.op_log.last_seq - self.sequencer.sequence_number
             for _ in range(max(0, gap)):
                 self.sequencer.system_message(MessageType.NO_OP, None)
+            # scribe's replica must fast-forward with the log too, or
+            # the first post-restart message trips its contiguity
+            # check (scribe/lambda.ts:108 skips below-checkpoint
+            # messages the same way)
+            self.scribe.protocol.sequence_number = (
+                self.sequencer.sequence_number
+            )
+            self.scribe.protocol.minimum_sequence_number = (
+                self.sequencer.minimum_sequence_number
+            )
             # every pre-crash connection is gone: sequence leaves for
             # the checkpointed clients so (a) their stale csn state
             # cannot silently swallow a reconnecting client's ops as
@@ -149,7 +159,11 @@ class LocalOrderer:
         return {"sequencer": self.sequencer.checkpoint()}
 
     def restore(self, state: dict) -> None:
-        self.sequencer = DocumentSequencer.restore(state["sequencer"])
+        # preserve the sequencer implementation (a NativeSequencerCore
+        # must not silently degrade to the Python path on restart)
+        self.sequencer = type(self.sequencer).restore(
+            state["sequencer"]
+        )
         # scribe's replica resumes at the checkpointed stream position
         # (scribe/lambda.ts:108 skips replayed messages below it)
         self.scribe.protocol.sequence_number = self.sequencer.sequence_number

@@ -58,6 +58,10 @@ class TpuMergeSidecar:
         self.max_capacity = max_capacity
         self._table = make_table(max_docs, capacity)
         self._slots: dict[tuple[str, str, str], int] = {}
+        # per-document slot index: ingest is called once per sequenced
+        # message per document — scanning every tracked channel there
+        # was accidentally O(docs) per message (O(docs^2) per window)
+        self._doc_slots: dict[str, list[tuple[int, str, str]]] = {}
         # the encoded stream is the single canonical per-doc history:
         # grow re-replays it on device, eviction decodes it back into
         # sequenced messages for the scalar replica (no duplicate raw
@@ -83,6 +87,9 @@ class TpuMergeSidecar:
             raise RuntimeError("sidecar document capacity exhausted")
         slot = len(self._streams)
         self._slots[key] = slot
+        self._doc_slots.setdefault(document_id, []).append(
+            (slot, datastore_id, channel_id)
+        )
         self._streams.append(DocStream())
         self._queued.append([])
         return slot
@@ -103,9 +110,7 @@ class TpuMergeSidecar:
         """Consume one sequenced message of a document: channel ops for
         tracked channels encode as kernel ops; everything else becomes
         a NOOP that still advances the collab window."""
-        for (doc, ds_id, ch_id), slot in self._slots.items():
-            if doc != document_id:
-                continue
+        for slot, ds_id, ch_id in self._doc_slots.get(document_id, ()):
             stream = self._streams[slot]
             envelope = msg.contents if isinstance(msg.contents, dict) else {}
             if (
@@ -194,11 +199,14 @@ class TpuMergeSidecar:
         for slot, (queue, ops) in enumerate(
             zip(self._queued, packed)
         ):
-            for w, op in enumerate(ops):
-                for f in OP_FIELDS:
-                    arrays[f][slot, w] = op[f]
-                if op["kind"] != KIND_NOOP:
-                    real += 1
+            if ops:
+                block = np.array(
+                    [[op[f] for f in OP_FIELDS] for op in ops],
+                    np.int32,
+                )
+                for i, f in enumerate(OP_FIELDS):
+                    arrays[f][slot, : len(ops)] = block[:, i]
+                real += int((block[:, 0] != KIND_NOOP).sum())
             queue.clear()
         self._table = apply_window(self._table, OpBatch(**arrays))
         return real
