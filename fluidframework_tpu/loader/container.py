@@ -99,12 +99,32 @@ class Container(EventEmitter):
              registry: Optional[ChannelRegistry] = None,
              client_id: str = "", connect: bool = True,
              mc: Optional["MonitoringContext"] = None,
-             replay_trailing: bool = True) -> "Container":
+             replay_trailing: bool = True,
+             pending_state: Optional[dict] = None) -> "Container":
         """``replay_trailing=False`` loads only the snapshot, leaving
         trailing-op replay to the caller (replay tool's step-by-step
-        mode)."""
+        mode). ``pending_state`` rehydrates an offline stash produced
+        by ``close_and_get_pending_state`` — stashed local ops
+        re-apply as pending and resubmit (rebased) on connect."""
         container = cls(service, registry, client_id, mc=mc)
         latest = service.get_latest_summary()
+        if pending_state is not None and latest is not None and \
+                latest[0] > pending_state.get("lastProcessedSeq", 0):
+            # the service summarized PAST the stash point: the stash's
+            # positions need the older view, so rehydrate from the op
+            # log instead of the snapshot — possible only while the
+            # log still retains the range (summary acks truncate it,
+            # scribe -> OpLog.truncate_below)
+            probe = service.read_ops(0, 1)
+            if not probe or probe[0].sequence_number != 1:
+                raise ValueError(
+                    "stash predates the service's op retention (a "
+                    "newer summary truncated the log below the stash "
+                    "point); the offline edits cannot be rebased "
+                    "exactly — rehydrate against a service retaining "
+                    "the full log, or discard the stash"
+                )
+            latest = None
         if latest is not None:
             version_seq, summary = latest
             container.runtime.load(summary.get("runtime", summary))
@@ -132,12 +152,61 @@ class Container(EventEmitter):
             container.last_processed_seq = base_seq
         # catch-up trailing ops from delta storage ("DocumentOpen",
         # deltaManager.ts:451)
+        if pending_state is not None:
+            # stashed ops carry positions valid at the stash-time view:
+            # replay the log up to that point, apply the stash as
+            # pending local state, then let the remaining ops flow
+            # through the NORMAL inbound path so pending state rebases
+            # over them exactly like live concurrency (container.ts
+            # offline load: stashed ops interleave at their refSeq)
+            stash_seq = pending_state.get("lastProcessedSeq", 0)
+            assert container.last_processed_seq <= stash_seq, (
+                "stash is older than the base snapshot; re-fetch an "
+                "older snapshot to rehydrate it"
+            )
+            for msg in service.read_ops(
+                container.last_processed_seq, stash_seq
+            ):
+                container._process(msg)
+            container.runtime.apply_stashed_state(
+                pending_state.get("pending", [])
+            )
         if replay_trailing:
             for msg in service.read_ops(container.last_processed_seq):
                 container._process(msg)
         if connect:
             container.connect()
         return container
+
+    def close_and_get_pending_state(self, force: bool = False) -> dict:
+        """closeAndGetPendingLocalState (container.ts): serialize the
+        pending local ops + stream position, close the container, and
+        return the stash. Rehydrate later with
+        ``Container.load(..., pending_state=state)`` — the offline
+        edits apply as pending and resubmit on connect.
+
+        Disconnects FIRST so unflushed edits stay local instead of
+        racing onto the wire at stash time (they would sequence AND
+        ride the stash — double-apply). Ops already sent but not yet
+        acknowledged are the same hazard from an earlier flush; by
+        default stashing refuses while any exist (process inbound acks
+        or stay offline before stashing); ``force=True`` accepts the
+        potential duplication."""
+        self.disconnect()
+        if self._sent_times and not force:
+            raise ValueError(
+                f"{len(self._sent_times)} op(s) in flight "
+                "(sent, unacknowledged): draining them first is "
+                "required for an exact stash — pass force=True to "
+                "stash anyway and accept potential duplication"
+            )
+        state = {
+            "clientId": self.client_id,
+            "lastProcessedSeq": self.last_processed_seq,
+            "pending": self.runtime.get_pending_state(),
+        }
+        self.close()
+        return state
 
     # ------------------------------------------------------------------
     # connection lifecycle (connectionManager.ts:152)

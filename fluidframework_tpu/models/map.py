@@ -99,6 +99,20 @@ class SharedMap(SharedObject, EventEmitter):
     def get(self, key: str, default: Any = None) -> Any:
         return self._kernel.data.get(key, default)
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate (sharedObject.ts:510): re-apply a
+        stashed op as pending local state."""
+        kind = contents["type"]
+        if kind == "set":
+            self._kernel.set_local(contents["key"], contents["value"])
+        elif kind == "delete":
+            self._kernel.delete_local(contents["key"])
+        elif kind == "clear":
+            self._kernel.clear_local()
+        else:
+            raise ValueError(f"unknown stashed map op {kind!r}")
+        return None
+
     def has(self, key: str) -> bool:
         return key in self._kernel.data
 

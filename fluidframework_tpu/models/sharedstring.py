@@ -168,6 +168,17 @@ class SharedString(SharedObject, EventEmitter):
             for iop in coll.regenerate_pending_ops():
                 self.submit_local_message(iop)
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate (client.ts:894 applyStashedOp):
+        re-author the stashed op as pending local state; reconnect
+        then regenerates and resubmits it rebased."""
+        if isinstance(contents, IntervalOp):
+            coll = self.get_interval_collection(contents.label)
+            return coll.apply_stashed_op(contents) \
+                if hasattr(coll, "apply_stashed_op") else None
+        self.client._apply_local(contents)
+        return None
+
     def signature(self):
         """Per-position (char|marker, props) content signature."""
         tree = self.client.mergetree

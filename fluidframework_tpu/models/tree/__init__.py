@@ -1,11 +1,24 @@
 """SharedTree: op-based tree CRDT with rebasing (packages/dds/tree)."""
 from . import changeset
+from .anchors import Anchor, AnchorSet
 from .changeset import compose, invert, rebase
+from .editable import EditableField, EditableNode, EditableRoot
 from .editmanager import Commit, EditManager
 from .forest import Forest, node
+from .schema import (
+    FieldSchema,
+    NodeSchema,
+    SchemaViolation,
+    StoredSchema,
+)
 from .sharedtree import SharedTree, wrap_path
 
 __all__ = [
     "changeset", "compose", "invert", "rebase",
-    "Commit", "EditManager", "Forest", "node", "SharedTree", "wrap_path",
+    "Anchor", "AnchorSet",
+    "Commit", "EditManager",
+    "EditableField", "EditableNode", "EditableRoot",
+    "FieldSchema", "Forest", "NodeSchema", "SchemaViolation",
+    "StoredSchema",
+    "node", "SharedTree", "wrap_path",
 ]

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field as dc_field
 from typing import Any, Optional
 
 from . import changeset as cs
+from .anchors import AnchorSet
 from .changeset import FieldChanges
 from .forest import Forest
 
@@ -67,6 +68,9 @@ class EditManager:
         # applied); current state = base + trunk + local_changes replay
         self.base_forest = base.clone() if base else Forest()
         self._current: Optional[Forest] = None
+        # anchors rebase over exactly the deltas the VIEW experiences
+        # (core/tree/anchorSet.ts)
+        self.anchors = AnchorSet()
 
     # ------------------------------------------------------------------
     # state
@@ -96,6 +100,7 @@ class EditManager:
         self.local_changes.append((change, tag))
         if self._current is not None:
             self._current.apply(change, tag)
+        self.anchors.apply(change)
         return tag
 
     def add_sequenced_change(self, commit: Commit,
@@ -128,7 +133,51 @@ class EditManager:
         self._add_commit_to_branch(branch, commit)
         self.trunk.append(Commit(commit.session_id, commit.seq,
                                  commit.ref_seq, rebased))
+        old_locals = list(self.local_changes)
         self._rebase_local_branch(rebased, commit.seq)
+        # anchor delta = the view's sandwich: retract old locals,
+        # apply the rebased peer commit, replay the new locals
+        for change, tag in reversed(old_locals):
+            self.anchors.apply(cs.invert(change, tag))
+        self.anchors.apply(rebased)
+        for change, _tag in self.local_changes:
+            self.anchors.apply(change)
+        self._current = None
+
+    def squash_local(self, tags: list) -> tuple[FieldChanges, Any]:
+        """Replace the (contiguous, trailing) local changes with the
+        given tags by ONE composed change — transaction commit
+        (core/transaction: a transaction's edits squash to a single
+        commit). Returns (composed_change, new_tag). The composed form
+        uses the CURRENT (rebased) shapes, so peer commits landing
+        mid-transaction are already accounted for."""
+        tagset = set(tags)
+        items = [(c, t) for c, t in self.local_changes if t in tagset]
+        keep = [(c, t) for c, t in self.local_changes
+                if t not in tagset]
+        assert keep + items == self.local_changes, (
+            "transaction changes must be the trailing local changes"
+        )
+        composed = cs.compose([c for c, _ in items])
+        tag = self._next_local_rev
+        self._next_local_rev -= 1
+        self.local_changes = keep + [(composed, tag)]
+        # state is unchanged (compose law) but replay tags differ
+        self._current = None
+        return composed, tag
+
+    def drop_local(self, tags: list) -> None:
+        """Remove local changes by tag — transaction abort. Repair
+        data makes the rollback exact: the view is recomputed without
+        the dropped changes (transaction + forestRepairDataStore)."""
+        tagset = set(tags)
+        dropped = [(c, t) for c, t in self.local_changes
+                   if t in tagset]
+        self.local_changes = [
+            (c, t) for c, t in self.local_changes if t not in tagset
+        ]
+        for change, tag in reversed(dropped):
+            self.anchors.apply(cs.invert(change, tag))
         self._current = None
 
     def advance_minimum_sequence_number(self, min_seq: int) -> None:

@@ -28,6 +28,7 @@ from . import changeset as cs
 from .changeset import FieldChanges
 from .editmanager import Commit, EditManager
 from .forest import Forest, node
+from .schema import StoredSchema
 
 
 def wrap_path(path: Sequence, leaf_marks: list) -> FieldChanges:
@@ -50,6 +51,11 @@ class SharedTree(SharedObject, EventEmitter):
         SharedObject.__init__(self, channel_id)
         EventEmitter.__init__(self)
         self._em = EditManager(session_id="detached")
+        # stored schema (core/schema-stored): None = unconstrained
+        self._schema: Optional[StoredSchema] = None
+        # open transaction: list of local revision tags (core/
+        # transaction; edits buffer locally, commit squashes + submits)
+        self._txn: Optional[list] = None
 
     # ------------------------------------------------------------------
 
@@ -76,21 +82,142 @@ class SharedTree(SharedObject, EventEmitter):
             i += 2
         return fields.get(path[-1], [])
 
+    def _parent_type(self, path: Sequence) -> Optional[str]:
+        """Node type owning field ``path[-1]`` (None at the root)."""
+        if len(path) == 1:
+            return None
+        from .schema import SchemaViolation
+
+        fields = self._em.forest().fields
+        i = 0
+        try:
+            while i < len(path) - 3:
+                fields = fields[path[i]][path[i + 1]].get("fields", {})
+                i += 2
+            return fields[path[i]][path[i + 1]].get("type")
+        except (KeyError, IndexError):
+            raise SchemaViolation(
+                f"edit path {tuple(path)!r} does not resolve to an "
+                "existing node under the stored schema"
+            ) from None
+
+    def editable(self):
+        """Typed editing surface (feature-libraries/editable-tree)."""
+        from .editable import EditableRoot
+
+        return EditableRoot(self)
+
+    # ------------------------------------------------------------------
+    # stored schema (modular-schema / schema-stored)
+
+    @property
+    def stored_schema(self) -> Optional[StoredSchema]:
+        return self._schema
+
+    def set_stored_schema(self, schema: StoredSchema) -> None:
+        """Propose a stored schema: current content must conform; the
+        schema activates when its op SEQUENCES (on every client,
+        deterministically) — adopting it optimistically would let a
+        concurrent edit that sequences first leave replicas holding a
+        schema the document violates. If the tree no longer conforms
+        at sequencing time the op is dropped everywhere
+        (schemaRejected event) — the same deterministic-outcome rule
+        consensus DDSes use."""
+        schema.validate_tree(self._em.forest().fields)
+        self.submit_local_message({
+            "type": "tree-schema", "schema": schema.to_json(),
+        })
+
+    # ------------------------------------------------------------------
+    # transactions (core/transaction + core/checkout)
+
+    def begin_transaction(self) -> None:
+        assert self._txn is None, "transactions do not nest"
+        self._txn = []
+
+    def commit_transaction(self) -> None:
+        assert self._txn is not None, "no open transaction"
+        tags, self._txn = self._txn, None
+        if not tags:
+            return
+        composed, tag = self._em.squash_local(tags)
+        self.submit_local_message(
+            {"type": "tree", "changes": composed},
+            metadata={"tag": tag},
+        )
+        self.emit("changed", local=True)
+
+    def abort_transaction(self) -> None:
+        """Roll every edit of the transaction back (repair data makes
+        deleted subtrees reattachable — forestRepairDataStore)."""
+        assert self._txn is not None, "no open transaction"
+        tags, self._txn = self._txn, None
+        if tags:
+            self._em.drop_local(tags)
+        self.emit("changed", local=True)
+
+    class _Transaction:
+        def __init__(self, tree: "SharedTree"):
+            self._tree = tree
+
+        def __enter__(self):
+            self._tree.begin_transaction()
+            return self._tree
+
+        def __exit__(self, exc_type, exc, tb):
+            if exc_type is None:
+                self._tree.commit_transaction()
+            else:
+                self._tree.abort_transaction()
+            return False
+
+    def transaction(self) -> "SharedTree._Transaction":
+        """``with tree.transaction(): ...`` — commits on success,
+        aborts (exact rollback) on exception."""
+        return SharedTree._Transaction(self)
+
+    # ------------------------------------------------------------------
+    # anchors (core/tree/anchorSet.ts)
+
+    def track_anchor(self, path: Sequence, index: int):
+        """Stable reference to the node at ``path``[``index``]; use
+        ``locate_anchor`` to read its current position (None once the
+        node is deleted)."""
+        return self._em.anchors.track(tuple(path) + (index,))
+
+    def locate_anchor(self, anchor):
+        return self._em.anchors.locate(anchor)
+
+    def forget_anchor(self, anchor) -> None:
+        self._em.anchors.forget(anchor)
+
     # ------------------------------------------------------------------
     # editing (the sequence-field editor surface)
 
     def insert_nodes(self, path: Sequence, index: int,
                      content: list) -> None:
+        if self._schema is not None:
+            self._schema.validate_insert(
+                self._parent_type(path), path[-1], content,
+                len(self.get_field(path)) + len(content),
+            )
         marks = ([cs.skip(index)] if index else []) + [cs.ins(content)]
         self._apply_local(wrap_path(path, marks))
 
     def delete_nodes(self, path: Sequence, index: int, count: int) -> None:
+        if self._schema is not None:
+            self._schema.validate_insert(
+                self._parent_type(path), path[-1], [],
+                len(self.get_field(path)) - count,
+            )
         marks = ([cs.skip(index)] if index else []) + [cs.dele(count)]
         self._apply_local(wrap_path(path, marks))
 
     def set_value(self, path: Sequence, index: int, value: Any) -> None:
         seq = self.get_field(path)
         old = seq[index].get("value") if index < len(seq) else None
+        if self._schema is not None and index < len(seq):
+            self._schema.validate_value(seq[index].get("type"), value)
         m = cs.mod(value={"new": value, "old": old})
         marks = ([cs.skip(index)] if index else []) + [m]
         self._apply_local(wrap_path(path, marks))
@@ -101,8 +228,14 @@ class SharedTree(SharedObject, EventEmitter):
 
     def _apply_local(self, changes: FieldChanges) -> None:
         tag = self._em.add_local_change(changes)
-        self.submit_local_message({"type": "tree", "changes": changes},
-                                  metadata={"tag": tag})
+        if self._txn is not None:
+            # buffered: commit_transaction squashes + submits once
+            self._txn.append(tag)
+        else:
+            self.submit_local_message(
+                {"type": "tree", "changes": changes},
+                metadata={"tag": tag},
+            )
         self.emit("changed", local=True)
 
     # ------------------------------------------------------------------
@@ -111,6 +244,21 @@ class SharedTree(SharedObject, EventEmitter):
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
         op = msg.contents
+        if isinstance(op, dict) and op.get("type") == "tree-schema":
+            # stored-schema evolution: sequenced-order LWW, applied
+            # only if the tree conforms AT SEQUENCING TIME (every
+            # replica evaluates the same state -> same outcome)
+            from .schema import SchemaViolation
+
+            schema = StoredSchema.from_json(op["schema"])
+            try:
+                schema.validate_tree(self._em.forest().fields)
+            except SchemaViolation:
+                self.emit("schemaRejected", local=local)
+                return
+            self._schema = schema
+            self.emit("schemaChanged", local=local)
+            return
         if not isinstance(op, dict) or op.get("type") != "tree":
             raise ValueError(f"unexpected tree op: {op!r}")
         commit = Commit(session_id=msg.client_id or "",
@@ -127,6 +275,10 @@ class SharedTree(SharedObject, EventEmitter):
         """Reconnect rebase (sharedObject.ts:378): the EditManager keeps
         local changes rebased against the trunk tip, so resubmit sends
         the *current* form, found by its local revision tag."""
+        if isinstance(contents, dict) and \
+                contents.get("type") == "tree-schema":
+            self.submit_local_message(contents, metadata)
+            return
         tag = (metadata or {}).get("tag")
         for change, t in self._em.local_changes:
             if t == tag:
@@ -137,6 +289,10 @@ class SharedTree(SharedObject, EventEmitter):
         # Unknown tag: the op was already sequenced; nothing to resend.
 
     def apply_stashed_op(self, contents: Any) -> Any:
+        if contents.get("type") == "tree-schema":
+            # a stashed schema proposal re-validates and resubmits;
+            # activation still happens only at sequencing
+            return None
         changes = contents["changes"]
         tag = self._em.add_local_change(changes)
         return {"tag": tag}
@@ -158,6 +314,8 @@ class SharedTree(SharedObject, EventEmitter):
                        "ref": c.ref_seq, "changes": c.changes}
                       for c in em.trunk],
             "min_seq": em.min_seq,
+            "schema": self._schema.to_json()
+            if self._schema is not None else None,
         }
 
     def load_core(self, summary: dict) -> None:
@@ -170,6 +328,10 @@ class SharedTree(SharedObject, EventEmitter):
                                    c["changes"]))
         em.min_seq = summary["min_seq"]
         self._em = em
+        schema = summary.get("schema")
+        self._schema = (
+            StoredSchema.from_json(schema) if schema else None
+        )
 
     def signature(self) -> Any:
         return self._em.forest().signature()

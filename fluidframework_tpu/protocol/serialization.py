@@ -10,25 +10,37 @@ import dataclasses
 import json
 from typing import Any
 
-from ..models.mergetree.ops import (
-    AnnotateOp,
-    DeltaType,
-    GroupOp,
-    InsertOp,
-    RemoveOp,
-)
 from .messages import ClientDetail, MessageType, SequencedMessage
 
-_OP_CLASSES = {
-    DeltaType.INSERT: InsertOp,
-    DeltaType.REMOVE: RemoveOp,
-    DeltaType.ANNOTATE: AnnotateOp,
-    DeltaType.GROUP: GroupOp,
-}
+
+def _op_vocab():
+    # lazy: the codec serves every layer, but layering keeps protocol
+    # below models (layer-check); the op classes load on first use
+    from ..models.mergetree.ops import (
+        AnnotateOp,
+        DeltaType,
+        GroupOp,
+        InsertOp,
+        RemoveOp,
+    )
+
+    return DeltaType, {
+        DeltaType.INSERT: InsertOp,
+        DeltaType.REMOVE: RemoveOp,
+        DeltaType.ANNOTATE: AnnotateOp,
+        DeltaType.GROUP: GroupOp,
+    }
 
 
 def encode_contents(value: Any) -> Any:
     from ..models.intervals import IntervalOp
+    from ..models.mergetree.ops import (
+        AnnotateOp,
+        DeltaType,
+        GroupOp,
+        InsertOp,
+        RemoveOp,
+    )
     from ..runtime.handles import FluidHandle
     if isinstance(value, FluidHandle):
         return {"__handle__": value.route}
@@ -63,11 +75,14 @@ def decode_contents(value: Any) -> Any:
             from ..models.intervals import IntervalOp
             return IntervalOp(**value["__intervalop__"])
         if "__mergeop__" in value:
+            from ..models.mergetree.ops import GroupOp
+
+            DeltaType, op_classes = _op_vocab()
             d = dict(value["__mergeop__"])
             kind = DeltaType(d.pop("type"))
             if kind == DeltaType.GROUP:
                 return GroupOp(ops=[decode_contents(o) for o in d["ops"]])
-            return _OP_CLASSES[kind](**d)
+            return op_classes[kind](**d)
         if "__clientdetail__" in value:
             d = dict(value["__clientdetail__"])
             d["scopes"] = tuple(d["scopes"])

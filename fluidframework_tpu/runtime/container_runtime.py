@@ -332,6 +332,61 @@ class ContainerRuntime(EventEmitter):
         self.flush()
 
     # ------------------------------------------------------------------
+    # offline stash (closeAndGetPendingLocalState / applyStashedOp,
+    # container.ts getPendingLocalState + sharedObject.ts:510)
+
+    def get_pending_state(self) -> list:
+        """JSON-safe serialization of every pending local op (the
+        runtime half of IPendingLocalState)."""
+        from ..protocol.serialization import encode_contents
+
+        self.flush()
+        return [
+            {
+                "kind": op.kind,
+                "datastore": op.datastore_id,
+                "channel": op.channel_id,
+                "contents": encode_contents(op.contents),
+                "metadata": encode_contents(op.metadata),
+            }
+            for op in self.pending._pending
+        ]
+
+    def apply_stashed_state(self, entries: list) -> None:
+        """Rehydrate stashed pending ops into a freshly loaded
+        runtime: attaches materialize their channels (dedup applies if
+        they sequenced after the stash), channel ops re-apply as
+        pending local state via each DDS's applyStashedOp hook; the
+        next connect resubmits everything through the normal
+        reconnect-rebase path."""
+        from ..protocol.serialization import decode_contents
+
+        for entry in entries:
+            contents = decode_contents(entry["contents"])
+            metadata = decode_contents(entry.get("metadata"))
+            op = PendingOp(entry["datastore"], entry["channel"],
+                           contents, metadata, kind=entry["kind"])
+            if op.kind == "attach":
+                self._process_attach({
+                    "address": op.datastore_id,
+                    "channel": op.channel_id,
+                    "contents": contents,
+                })
+                self.pending.on_submit(op)
+                continue
+            if op.kind != "op":
+                self.pending.on_submit(op)  # e.g. blobAttach: verbatim
+                continue
+            channel = self.datastores[op.datastore_id].channels[
+                op.channel_id
+            ]
+            new_meta = channel.apply_stashed_op(contents)
+            self.pending.on_submit(PendingOp(
+                op.datastore_id, op.channel_id, contents,
+                new_meta if new_meta is not None else metadata,
+            ))
+
+    # ------------------------------------------------------------------
     # summary (§3.4 client side)
 
     def summarize(self, unchanged: frozenset = frozenset()) -> dict:

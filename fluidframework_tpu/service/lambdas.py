@@ -132,15 +132,6 @@ class SummaryStore:
             self._trees = SummaryTreeStore()
             self._mem_roots: Optional[list[tuple[int, str]]] = []
 
-    @property
-    def _roots(self) -> list[tuple[int, str]]:
-        if self._storage is not None:
-            return [
-                (v.sequence_number, v.root)
-                for v in self._storage.versions
-            ]
-        return self._mem_roots
-
     def write(self, sequence_number: int, summary: dict) -> str:
         """Store a summary (resolving handles); returns the root sha —
         the ack handle clients see (summaryAck.handle)."""
@@ -152,15 +143,23 @@ class SummaryStore:
         return root
 
     def latest(self) -> Optional[ServiceSummary]:
-        roots = self._roots
-        if not roots:
+        if self._storage is not None:
+            if not self._storage.versions:
+                return None
+            v = self._storage.versions[-1]
+            return ServiceSummary(
+                v.sequence_number, self._trees.read(v.root)
+            )
+        if not self._mem_roots:
             return None
-        seq, root = roots[-1]
+        seq, root = self._mem_roots[-1]
         return ServiceSummary(seq, self._trees.read(root))
 
     @property
     def version_count(self) -> int:
-        return len(self._roots)
+        if self._storage is not None:
+            return len(self._storage.versions)
+        return len(self._mem_roots)
 
     def object_count(self) -> int:
         return self._trees.store.object_count()

@@ -1,0 +1,95 @@
+"""Generate the golden snapshot+oplog fixtures (run ONCE per format
+version; the committed outputs are historical artifacts that CI loads
+— regenerating them silently would defeat the back-compat check, so
+only run this when intentionally minting fixtures for a NEW version).
+
+Reference: packages/test/snapshots (stored-format replay validation).
+"""
+import hashlib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from fluidframework_tpu.drivers import (  # noqa: E402
+    LocalDocumentServiceFactory,
+    save_document,
+)
+from fluidframework_tpu.loader import Container  # noqa: E402
+from fluidframework_tpu.models.tree import node  # noqa: E402
+from fluidframework_tpu.service.local_server import (  # noqa: E402
+    LocalServer,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_session():
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    c = Container.load(factory.create_document_service("golden"),
+                       client_id="author")
+    ds = c.runtime.create_datastore("app")
+    text = ds.create_channel("sharedstring", "text")
+    kv = ds.create_channel("sharedmap", "kv")
+    tree = ds.create_channel("sharedtree", "tree")
+    grid = ds.create_channel("sharedmatrix", "grid")
+    c.flush()
+
+    text.insert_text(0, "golden snapshot fixture")
+    text.annotate_range(0, 6, {"style": "bold"})
+    kv.set("version", 3)
+    kv.set("author", "round-3")
+    tree.insert_nodes(("root",), 0, [
+        node("doc", value="fixture", ),
+    ])
+    tree.insert_nodes(("root", 0, "children"), 0, [
+        node("leaf", value=i) for i in range(3)
+    ])
+    grid.insert_rows(0, 2)
+    grid.insert_cols(0, 2)
+    for r in range(2):
+        for co in range(2):
+            grid.set_cell(r, co, r * 2 + co)
+    c.flush()
+    c.summarize()
+
+    # trailing ops AFTER the summary (load = snapshot + replay)
+    text.insert_text(0, ">> ")
+    kv.set("version", 4)
+    c.flush()
+    return server, c, {"text": text, "kv": kv, "tree": tree,
+                       "grid": grid}
+
+
+def main() -> None:
+    server, c, channels = build_session()
+    summary = server.latest_summary("golden")
+    ops = server.read_ops("golden", 0)
+    out = os.path.join(HERE, "golden_v1.json")
+    save_document(out, "golden", ops,
+                  (summary.sequence_number, summary.summary))
+    expectations = {
+        "text": channels["text"].get_text(),
+        "kv_version": channels["kv"].get("version"),
+        "tree_signature_sha": hashlib.sha256(
+            str(channels["tree"].signature()).encode()
+        ).hexdigest(),
+        "grid_cells": [
+            [channels["grid"].get_cell(r, co) for co in range(2)]
+            for r in range(2)
+        ],
+        "final_seq": c.last_processed_seq,
+    }
+    with open(os.path.join(HERE, "golden_v1.expect.json"), "w") as f:
+        json.dump(expectations, f, indent=2, sort_keys=True)
+    print("wrote", out)
+    print(json.dumps(expectations, indent=2)[:400])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Persisted-format back-compat: committed golden fixtures must load
+in every future round.
+
+Reference: packages/test/snapshots (README.md:1-16) — stored old-format
+snapshots + op logs are replayed and validated on every build, so a
+format change that breaks loading fails LOUDLY here instead of
+corrupting real documents. The fixtures are historical artifacts:
+regenerate ONLY when minting a new format version (add a new
+golden_vN, never overwrite old ones).
+"""
+import hashlib
+import json
+import os
+
+from fluidframework_tpu.drivers import load_document
+from fluidframework_tpu.loader import Container
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "fixtures")
+
+
+def _load(name):
+    service = load_document(os.path.join(HERE, f"{name}.json"))
+    with open(os.path.join(HERE, f"{name}.expect.json")) as f:
+        return service, json.load(f)
+
+
+def test_golden_v1_loads_and_matches():
+    service, expect = _load("golden_v1")
+    c = Container.load(service, client_id="reader", connect=False)
+    ds = c.runtime.get_datastore("app")
+    assert ds.get_channel("text").get_text() == expect["text"]
+    assert ds.get_channel("kv").get("version") == expect["kv_version"]
+    sig = hashlib.sha256(
+        str(ds.get_channel("tree").signature()).encode()
+    ).hexdigest()
+    assert sig == expect["tree_signature_sha"]
+    grid = ds.get_channel("grid")
+    cells = [[grid.get_cell(r, co) for co in range(2)]
+             for r in range(2)]
+    assert cells == expect["grid_cells"]
+    assert c.last_processed_seq == expect["final_seq"]
+
+
+def test_golden_v1_resummarizes_and_reloads():
+    """Round-trip: a summary produced by TODAY's code from the golden
+    state must load back identically (forward path of the compat
+    matrix)."""
+    service, expect = _load("golden_v1")
+    c = Container.load(service, client_id="reader", connect=False)
+    summary = {
+        "protocol": c.protocol.snapshot(),
+        "runtime": c.runtime.summarize(),
+    }
+    from fluidframework_tpu.models import default_registry
+    from fluidframework_tpu.runtime import ContainerRuntime
+
+    fresh = ContainerRuntime(default_registry())
+    fresh.load(summary["runtime"])
+    ds = fresh.get_datastore("app")
+    assert ds.get_channel("text").get_text() == expect["text"]
+    assert ds.get_channel("kv").get("version") == expect["kv_version"]

@@ -1,0 +1,275 @@
+"""SharedTree round-3 parity: stored schema, transactions + repair
+rollback, AnchorSet, editable-tree surface.
+
+Reference parity targets: feature-libraries/modular-schema (field
+kinds), core/schema-stored (replicated schema), core/transaction +
+forestRepairDataStore (atomic commit/abort with exact rollback),
+core/tree/anchorSet.ts (anchors slide with edits, die on delete),
+feature-libraries/editable-tree (typed surface).
+"""
+import pytest
+
+from fluidframework_tpu.models.tree import (
+    FieldSchema,
+    NodeSchema,
+    SchemaViolation,
+    StoredSchema,
+    node,
+)
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+
+def make(n=2):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    s = ContainerSession(ids)
+    for cid in ids:
+        s.runtime(cid).create_datastore("d").create_channel(
+            "sharedtree", "t")
+    s.process_all()
+    return s, ids
+
+
+def tree(s, cid):
+    return s.runtime(cid).get_datastore("d").get_channel("t")
+
+
+def _schema():
+    return StoredSchema(
+        nodes={
+            "list": NodeSchema("list", value="none", fields={
+                "items": FieldSchema("sequence",
+                                     allowed_types=("item",)),
+            }),
+            "item": NodeSchema("item", value="number"),
+        },
+        root_fields={"root": FieldSchema("sequence",
+                                         allowed_types=("list",))},
+    )
+
+
+# ----------------------------------------------------------------------
+# stored schema
+
+def test_schema_validates_and_replicates():
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    a.insert_nodes(("root",), 0, [node("list")])
+    s.process_all()
+    a.set_stored_schema(_schema())
+    s.process_all()
+    assert b.stored_schema is not None
+
+    # both sides now reject violations locally
+    with pytest.raises(SchemaViolation):
+        a.insert_nodes(("root",), 0, [node("item", value=1)])
+    with pytest.raises(SchemaViolation):
+        b.insert_nodes(("root", 0, "items"), 0,
+                       [node("list")])  # wrong child type
+    with pytest.raises(SchemaViolation):
+        b.insert_nodes(("root", 0, "items"), 0,
+                       [node("item", value="not-a-number")])
+
+    # conforming edits flow
+    b.insert_nodes(("root", 0, "items"), 0, [node("item", value=7)])
+    s.process_all()
+    s.assert_converged()
+    assert a.get_field(("root", 0, "items"))[0]["value"] == 7
+
+
+def test_schema_rejects_nonconforming_adoption():
+    s, _ = make()
+    a = tree(s, "A")
+    a.insert_nodes(("root",), 0, [node("rogue")])
+    s.process_all()
+    with pytest.raises(SchemaViolation):
+        a.set_stored_schema(_schema())
+
+
+def test_schema_value_and_optional_cardinality():
+    schema = StoredSchema(
+        nodes={"box": NodeSchema("box", fields={
+            "lid": FieldSchema("optional"),
+            "label": FieldSchema("value"),
+        }, extra_fields=True)},
+    )
+    schema.validate_node(node("box", fields={"label": [node("box",
+        fields={"label": [node("box", fields={"label": [node("box")]}
+                               )]})]}))
+    with pytest.raises(SchemaViolation):
+        schema.validate_node(node("box", fields={
+            "label": [node("box"), node("box")],
+        }))
+    with pytest.raises(SchemaViolation):
+        schema.validate_node(node("box", fields={
+            "lid": [node("box"), node("box")], "label": [node("box")],
+        }))
+
+
+def test_schema_survives_summary_roundtrip():
+    s, _ = make()
+    a = tree(s, "A")
+    a.insert_nodes(("root",), 0, [node("list")])
+    s.process_all()
+    a.set_stored_schema(_schema())
+    s.process_all()
+    summary = a.summarize_core()
+    fresh = type(a)("t2")
+    fresh.load_core(summary)
+    assert fresh.stored_schema is not None
+    with pytest.raises(SchemaViolation):
+        fresh.insert_nodes(("root",), 0, [node("item", value=1)])
+
+
+# ----------------------------------------------------------------------
+# transactions
+
+def test_transaction_commits_as_one_op():
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    a.insert_nodes(("root",), 0, [node("n", value=0)])
+    s.process_all()
+
+    with a.transaction():
+        a.insert_nodes(("root",), 1, [node("n", value=1)])
+        a.insert_nodes(("root",), 2, [node("n", value=2)])
+        a.set_value(("root",), 0, 99)
+        # local view reflects buffered edits immediately
+        assert [n["value"] for n in a.get_field(("root",))] == \
+            [99, 1, 2]
+    seq_before = s.sequencer.sequence_number
+    s.process_all()
+    # exactly ONE sequenced op carries the squashed transaction
+    assert s.sequencer.sequence_number - seq_before == 1
+    s.assert_converged()
+    assert [n["value"] for n in b.get_field(("root",))] == [99, 1, 2]
+
+
+def test_transaction_abort_rolls_back_exactly():
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    a.insert_nodes(("root",), 0,
+                   [node("n", value=i) for i in range(3)])
+    s.process_all()
+    before = a.signature()
+
+    with pytest.raises(RuntimeError):
+        with a.transaction():
+            a.delete_nodes(("root",), 0, 2)  # repair data captured
+            a.insert_nodes(("root",), 0, [node("x")])
+            raise RuntimeError("boom")
+    assert a.signature() == before
+    s.process_all()  # nothing was submitted
+    s.assert_converged()
+    assert b.signature() == before
+
+
+def test_transaction_with_concurrent_peer_commit():
+    """A peer commit sequencing mid-transaction rebases the buffered
+    edits; the squashed commit still converges."""
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    a.insert_nodes(("root",), 0,
+                   [node("n", value=i) for i in range(3)])
+    s.process_all()
+
+    a.begin_transaction()
+    a.set_value(("root",), 2, 22)
+    b.insert_nodes(("root",), 0, [node("n", value=-1)])
+    s.process_all()  # b's edit lands mid-transaction
+    a.commit_transaction()
+    s.process_all()
+    s.assert_converged()
+    assert [n["value"] for n in b.get_field(("root",))] == \
+        [-1, 0, 1, 22]
+
+
+# ----------------------------------------------------------------------
+# anchors
+
+def test_anchor_slides_with_edits_and_dies_on_delete():
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    a.insert_nodes(("root",), 0,
+                   [node("n", value=i) for i in range(4)])
+    s.process_all()
+
+    anchor = a.track_anchor(("root",), 2)
+    assert a.locate_anchor(anchor) == ("root", 2)
+
+    # local insert before: slides right
+    a.insert_nodes(("root",), 0, [node("x")])
+    assert a.locate_anchor(anchor) == ("root", 3)
+
+    # remote delete before: slides left (after rebase of the local op)
+    b.delete_nodes(("root",), 0, 1)
+    s.process_all()
+    loc = a.locate_anchor(anchor)
+    field = a.get_field(("root",))
+    assert field[loc[1]]["value"] == 2  # still the same node
+
+    # deleting the anchored node kills the anchor
+    a.delete_nodes(("root",), loc[1], 1)
+    assert a.locate_anchor(anchor) is None
+
+
+def test_anchor_in_nested_field():
+    s, _ = make()
+    a = tree(s, "A")
+    a.insert_nodes(("root",), 0, [node("list")])
+    a.insert_nodes(("root", 0, "items"), 0,
+                   [node("item", value=i) for i in range(3)])
+    s.process_all()
+    anchor = a.track_anchor(("root", 0, "items"), 1)
+    a.insert_nodes(("root", 0, "items"), 0, [node("item", value=9)])
+    loc = a.locate_anchor(anchor)
+    assert loc == ("root", 0, "items", 2)
+    assert a.get_field(loc[:-1])[loc[-1]]["value"] == 1
+
+
+# ----------------------------------------------------------------------
+# editable-tree surface
+
+def test_editable_tree_reads_and_writes():
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    root = a.editable()
+    root.field("root").insert(0, [node("list")])
+    items = root.field("root")[0].field("items")
+    items.append([node("item", value=1), node("item", value=2)])
+    items[0].value = 10
+    s.process_all()
+    s.assert_converged()
+
+    bitems = b.editable().field("root")[0].field("items")
+    assert [n.value for n in bitems] == [10, 2]
+    assert bitems[-1].type == "item"
+    del bitems[0:1]
+    s.process_all()
+    s.assert_converged()
+    assert [n.value
+            for n in a.editable().field("root")[0].field("items")] == [2]
+    anchor = bitems[0].anchor()
+    bitems.insert(0, [node("item", value=0)])
+    assert b.locate_anchor(anchor)[-1] == 1
+
+def test_schema_race_with_concurrent_edit_rejects_deterministically():
+    """A concurrent edit that sequences BEFORE the schema op and
+    violates it must cause every replica to drop the schema op (same
+    state -> same outcome), never to hold a schema the tree violates
+    (code-review r3)."""
+    s, _ = make()
+    a, b = tree(s, "A"), tree(s, "B")
+    a.insert_nodes(("root",), 0, [node("list")])
+    s.process_all()
+
+    rejected = []
+    b.on("schemaRejected", lambda **kw: rejected.append(1))
+    b.insert_nodes(("root",), 0, [node("rogue")])
+    a.set_stored_schema(_schema())  # authored before seeing rogue
+    s.flush("B")  # rogue sequences FIRST
+    s.flush("A")
+    s.process_all()
+    s.assert_converged()
+    assert a.stored_schema is None
+    assert b.stored_schema is None
+    assert rejected
