@@ -3,6 +3,12 @@
 Reference analogue: packages/drivers/*.
 """
 from .definitions import DeltaStreamConnection, DocumentService
+from .driver_utils import (
+    PrefetchingDocumentService,
+    RetriableError,
+    RetryDocumentService,
+    run_with_retry,
+)
 from .file_driver import load_document, save_document
 from .local_driver import LocalDocumentService, LocalDocumentServiceFactory
 from .replay_driver import ReplayDocumentService
@@ -14,6 +20,10 @@ from .socket_driver import (
 __all__ = [
     "DeltaStreamConnection",
     "DocumentService",
+    "PrefetchingDocumentService",
+    "RetriableError",
+    "RetryDocumentService",
+    "run_with_retry",
     "LocalDocumentService",
     "LocalDocumentServiceFactory",
     "ReplayDocumentService",

@@ -1,0 +1,157 @@
+"""Shared driver plumbing: retry, throttling backoff, snapshot
+prefetch.
+
+Reference: packages/loader/driver-utils — ``runWithRetry`` (retriable
+error loop with backoff + throttling respect), ``prefetchSnapshot``
+(warm the snapshot/ops caches before Container.load), and the
+compression utilities (op compression already lives in
+runtime/op_lifecycle.py).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class RetriableError(Exception):
+    """An error the driver layer may retry (canRetry=true errors).
+    ``retry_after_seconds`` mirrors service throttling responses."""
+
+    def __init__(self, message: str = "",
+                 retry_after_seconds: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_seconds = retry_after_seconds
+
+
+def run_with_retry(fn: Callable[[], T], *,
+                   max_retries: int = 5,
+                   base_delay_s: float = 0.05,
+                   max_delay_s: float = 5.0,
+                   retriable=(RetriableError, ConnectionError,
+                              TimeoutError),
+                   sleep: Callable[[float], None] = time.sleep,
+                   on_retry: Optional[Callable[[int, Exception], None]]
+                   = None) -> T:
+    """driver-utils runWithRetry: call ``fn`` until it succeeds or a
+    non-retriable error/exhaustion; exponential backoff, honoring a
+    throttler's retry_after_seconds when present."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retriable as e:  # noqa: PERF203 - retry loop
+            attempt += 1
+            if attempt > max_retries:
+                raise
+            delay = min(max_delay_s, base_delay_s * (2 ** (attempt - 1)))
+            hinted = getattr(e, "retry_after_seconds", None)
+            if hinted is not None:
+                delay = max(delay, hinted)
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(delay)
+
+
+class PrefetchingDocumentService:
+    """prefetchSnapshot: wraps any DocumentService, fetching the
+    latest summary and trailing ops ONCE (optionally ahead of time)
+    and serving Container.load's storage reads from the cache — the
+    reference uses this to overlap snapshot fetch with boot."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.document_id = inner.document_id
+        self._summary: Any = None
+        self._ops: Optional[list] = None
+        self._base = 0
+
+    def prefetch(self) -> "PrefetchingDocumentService":
+        self._summary = self._inner.get_latest_summary()
+        if self._summary is not None:
+            # the load path replays from the snapshot's PROTOCOL
+            # position (the summarize op itself sequences after the
+            # snapshotted state), so the cache must start there, not
+            # at the summary version's seq
+            seq, tree = self._summary
+            base = (tree.get("protocol") or {}).get(
+                "sequenceNumber", seq
+            )
+        else:
+            base = 0
+        self._base = base
+        self._ops = self._inner.read_ops(base)
+        return self
+
+    # -- DocumentService surface ---------------------------------------
+
+    def get_latest_summary(self):
+        if self._ops is None:
+            self.prefetch()
+        return self._summary
+
+    def read_ops(self, from_seq: int, to_seq=None):
+        if self._ops is None:
+            self.prefetch()
+        base = self._base
+        covered_to = (self._ops[-1].sequence_number
+                      if self._ops else base)
+        if from_seq < base:
+            # below the prefetched window: the cache cannot answer
+            # (it starts at base+1) — delegate to the live service
+            return self._inner.read_ops(from_seq, to_seq)
+        if from_seq < covered_to:
+            # inside the prefetched view: serve the cached consistent
+            # snapshot (a load against it sees exactly prefetch-time
+            # state; newer ops arrive via connect()'s catch-up below)
+            return [m for m in self._ops
+                    if m.sequence_number > from_seq
+                    and (to_seq is None
+                         or m.sequence_number <= to_seq)]
+        # past the prefetched range: live service
+        return self._inner.read_ops(from_seq, to_seq)
+
+    def connect_to_delta_stream(self, client_id, on_message,
+                                on_nack=None):
+        return self._inner.connect_to_delta_stream(
+            client_id, on_message, on_nack
+        )
+
+    def close(self) -> None:
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()
+
+
+class RetryDocumentService:
+    """Wraps a DocumentService so its storage reads run under
+    runWithRetry (transient socket drops / throttling survive)."""
+
+    def __init__(self, inner, **retry_kwargs):
+        self._inner = inner
+        self._kw = retry_kwargs
+        self.document_id = inner.document_id
+
+    def get_latest_summary(self):
+        return run_with_retry(self._inner.get_latest_summary,
+                              **self._kw)
+
+    def read_ops(self, from_seq: int, to_seq=None):
+        return run_with_retry(
+            lambda: self._inner.read_ops(from_seq, to_seq), **self._kw
+        )
+
+    def connect_to_delta_stream(self, client_id, on_message,
+                                on_nack=None):
+        return run_with_retry(
+            lambda: self._inner.connect_to_delta_stream(
+                client_id, on_message, on_nack
+            ),
+            **self._kw,
+        )
+
+    def close(self) -> None:
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()
