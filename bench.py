@@ -497,10 +497,11 @@ def stage_config4(scale: str, reps: int, cooldown: float) -> dict:
         [encode_changeset(c)[0] for c, _, _ in cases])
     trunk = TreeAtoms(*[
         np.stack([
-            np.stack([encode_changeset(o)[0][f] for o in overs])
+            np.stack([encode_changeset(o, allow_moves=False)[0][f]
+                      for o in overs])
             for _, overs, _ in cases
         ])
-        for f in ("kind", "pos", "n", "muted")
+        for f in ("kind", "pos", "n", "muted", "pos2")
     ])
 
     t0 = time.perf_counter()

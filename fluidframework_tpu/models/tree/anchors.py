@@ -90,7 +90,26 @@ class AnchorSet:
     def _adjust(marks: list, idx: int):
         """New index of input-node ``idx`` after ``marks``, plus the
         mod mark covering it (for descending). Returns (None, None)
-        when a delete covers the node."""
+        when a delete covers the node — unless a rev in the same list
+        revives that very node (a MOVE: the anchor follows it to the
+        destination, anchorSet.ts move semantics)."""
+        # pre-pass: output position of every revived node identity
+        rev_map: dict = {}
+        out_scan = 0
+        for m in marks:
+            t = m["t"]
+            if t == "rev":
+                for j in range(m["n"]):
+                    rev_map[(m["rev"], m["idx"] + j)] = out_scan + j
+                out_scan += m["n"]
+            elif t == "skip":
+                out_scan += m["n"]
+            elif t == "ins":
+                out_scan += len(m["content"])
+            elif t == "mod":
+                out_scan += 1
+            # del / tomb contribute no output
+
         in_pos = 0   # input coordinate walker
         out_pos = 0  # output coordinate walker
         for m in marks:
@@ -106,6 +125,13 @@ class AnchorSet:
                 out_pos += m["n"]
             elif t == "del":
                 if in_pos + m["n"] > idx:
+                    did = m.get("did")
+                    if did is not None:
+                        dest = rev_map.get(
+                            (did[0], did[1] + (idx - in_pos))
+                        )
+                        if dest is not None:
+                            return dest, None  # moved, not deleted
                     return None, None
                 in_pos += m["n"]
             elif t == "mod":

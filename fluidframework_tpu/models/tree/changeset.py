@@ -63,7 +63,10 @@ EditManager's total-order rebasing):
 from __future__ import annotations
 
 import copy
+import itertools
 from typing import Any, Optional
+
+_PAIR_COUNTER = itertools.count()
 
 Mark = dict
 MarkList = list
@@ -104,6 +107,38 @@ def mod(value: Optional[dict] = None,
 
 def tomb(n: int, key: list, was: Mark) -> Mark:
     return {"t": "tomb", "n": n, "key": key, "was": was}
+
+
+def move(src: int, count: int, dst: int, pair: Any = None) -> MarkList:
+    """Same-field move of ``count`` nodes from input position ``src``
+    to input position ``dst`` (outside the moved range), expressed as
+    a paired detach+revive: the del detaches the nodes under a birth
+    identity and the rev reattaches exactly those nodes at ``dst``
+    (MoveOut/MoveIn, sequence-field/format.ts — here the pairing rides
+    the existing del/rev identity machinery, so compose, invert —
+    a move's inverse is the move back — and rebasing, including
+    muting/unmuting through tombstones, need no new mark kind).
+    ``stamp`` resolves the pairing token into real identities.
+
+    Concurrency: DELETE WINS — if another client concurrently deletes
+    the source nodes, both halves mute (the nodes stay deleted; they
+    return, moved, only if that delete is itself undone)."""
+    if not (dst <= src or dst >= src + count):
+        raise ValueError("move destination inside the moved range")
+    token = pair if pair is not None else (
+        f"__pair{next(_PAIR_COUNTER)}"  # unique per authored move:
+        # geometry-based tokens collide across fields (stamp resolves
+        # pairings changeset-wide)
+    )
+    d = {"t": "del", "n": count, "mv": token}
+    r = {"t": "rev", "n": count, "rev": None, "idx": 0, "mv": token}
+    if dst <= src:
+        return normalize(
+            [skip(dst), r, skip(src - dst), d]
+        )
+    return normalize(
+        [skip(src), d, skip(dst - src - count), r]
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -235,7 +270,8 @@ def normalize(marks: MarkList) -> MarkList:
                 p["n"] += m["n"]
                 continue
             if (p["t"] == t == "del" and "did" not in p and "did" not in m
-                    and "rbof" not in p and "rbof" not in m):
+                    and "rbof" not in p and "rbof" not in m
+                    and "mv" not in p and "mv" not in m):
                 p["n"] += m["n"]
                 continue
             if (p["t"] == t == "del" and "did" in p and "did" in m
@@ -245,6 +281,7 @@ def normalize(marks: MarkList) -> MarkList:
                 p["n"] += m["n"]
                 continue
             if (p["t"] == t == "rev" and p["rev"] == m["rev"]
+                    and p["rev"] is not None
                     and p["idx"] + p["n"] == m["idx"]
                     and "mods" not in p and "mods" not in m):
                 p["n"] += m["n"]
@@ -283,13 +320,32 @@ def stamp(changes: FieldChanges, uid: str) -> FieldChanges:
     """Stamp birth identities (``iid`` on ins, ``did`` on del) into a
     freshly authored changeset, in the canonical walk order (marks in
     list order, ``mod`` nested fields sorted by key). Already-stamped
-    marks keep their identity (resubmits must not re-identify)."""
+    marks keep their identity (resubmits must not re-identify).
+    Move pairings (``mv`` tokens from :func:`move`) resolve here: the
+    rev half adopts its del half's freshly assigned identity."""
     counters = {"a": 0, "d": 0}
-    _stamp_fields(changes, uid, counters)
+    pairs: dict = {}
+    _stamp_fields(changes, uid, counters, pairs)
+    _resolve_moves(changes, pairs)
     return changes
 
 
-def _stamp_fields(changes: FieldChanges, uid: str, counters: dict) -> None:
+def _resolve_moves(changes: FieldChanges, pairs: dict) -> None:
+    for key in sorted(changes):
+        for m in changes[key]:
+            if m["t"] == "rev" and m.get("rev") is None:
+                did = pairs.get(m.get("mv"))
+                if did is None:
+                    raise ValueError(
+                        f"unpaired move revive {m.get('mv')!r}"
+                    )
+                m["rev"], m["idx"] = did[0], did[1]
+            elif m["t"] == "mod" and m.get("fields"):
+                _resolve_moves(m["fields"], pairs)
+
+
+def _stamp_fields(changes: FieldChanges, uid: str, counters: dict,
+                  pairs: Optional[dict] = None) -> None:
     for key in sorted(changes):
         for m in changes[key]:
             t = m["t"]
@@ -300,9 +356,11 @@ def _stamp_fields(changes: FieldChanges, uid: str, counters: dict) -> None:
             elif t == "del":
                 if "did" not in m and "rbof" not in m:
                     m["did"] = [uid, counters["d"]]
+                if pairs is not None and "mv" in m:
+                    pairs[m["mv"]] = m["did"]
                 counters["d"] += m["n"]
             elif t == "mod" and m.get("fields"):
-                _stamp_fields(m["fields"], uid, counters)
+                _stamp_fields(m["fields"], uid, counters, pairs)
 
 
 # ---------------------------------------------------------------------------
@@ -404,6 +462,12 @@ def _compose_marks(a_marks: MarkList, b_marks: MarkList) -> MarkList:
     """``a`` then ``b``: b consumes a's output sequence."""
     a = _Queue(a_marks)
     out: MarkList = []
+    # b-del erasing an a-attach (ins+del -> never existed; rev+del ->
+    # stays detached) also erases that del's IDENTITY — a rev in b
+    # paired to it (b moving nodes a just attached) would orphan.
+    # Record what each erased-del node really was so the post-pass can
+    # rewrite such revs into direct attaches of the source.
+    erased: dict = {}
     for bm in copy.deepcopy(b_marks):
         if bm["t"] == "tomb" or is_attach(bm):
             out.append(bm)
@@ -425,16 +489,66 @@ def _compose_marks(a_marks: MarkList, b_marks: MarkList) -> MarkList:
                 bpiece, bm = _split(bm, m)
             else:
                 bpiece, bm = bm, None
-            out.extend(_compose_pair(apiece, bpiece))
+            out.extend(_compose_pair(apiece, bpiece, erased))
             need -= in_len(bpiece)
             if bm is None:
                 break
     while not a.empty:
         out.append(a.pop())
+    if erased:
+        out = _reroute_erased_revs(out, erased)
     return normalize(out)
 
 
-def _compose_pair(am: Mark, bm: Mark) -> MarkList:
+def _reroute_erased_revs(marks: MarkList, erased: dict) -> MarkList:
+    """Rewrite rev pieces whose source del was erased in composition:
+    nodes born of an erased ins attach as fresh content; nodes that
+    were a re-detach of an older revive re-attach under the ORIGINAL
+    detach identity."""
+    out: MarkList = []
+    for m in marks:
+        if m["t"] != "rev":
+            out.append(m)
+            continue
+        i = 0
+        while i < m["n"]:
+            src = erased.get((m["rev"], m["idx"] + i))
+            if src is None:
+                j = i
+                while j < m["n"] and erased.get(
+                    (m["rev"], m["idx"] + j)
+                ) is None:
+                    j += 1
+                keep = {**m, "n": j - i, "idx": m["idx"] + i}
+                if "mods" in m:
+                    sel = {str(int(o) - i): mm
+                           for o, mm in m["mods"].items()
+                           if i <= int(o) < j}
+                    if sel:
+                        keep["mods"] = sel
+                    else:
+                        keep.pop("mods", None)
+                out.append(keep)
+                i = j
+                continue
+            kind, payload = src[0], src[1:]
+            if kind == "content":
+                nd = copy.deepcopy(payload[0])
+                mm = (m.get("mods") or {}).get(str(i))
+                out.append(ins([_mod_node(nd, mm) if mm else nd]))
+            else:  # ("rev", orig_u, orig_idx)
+                piece = {"t": "rev", "n": 1, "rev": payload[0],
+                         "idx": payload[1]}
+                mm = (m.get("mods") or {}).get(str(i))
+                if mm is not None:
+                    piece["mods"] = {"0": mm}
+                out.append(piece)
+            i += 1
+    return out
+
+
+def _compose_pair(am: Mark, bm: Mark,
+                  erased: Optional[dict] = None) -> MarkList:
     """Net marks for an aligned (a output piece, b sized piece)."""
     bt = bm["t"]
     at = am["t"]
@@ -444,9 +558,23 @@ def _compose_pair(am: Mark, bm: Mark) -> MarkList:
         if at == "skip":
             return [bm]
         if at == "ins":
-            return []          # inserted then deleted: never existed
+            # inserted then deleted: never existed — but record the
+            # erased identity's true content for paired revs (moves)
+            if erased is not None and "did" in bm:
+                u, b0 = bm["did"]
+                for j, nd in enumerate(am["content"]):
+                    erased[(u, b0 + j)] = ("content", nd)
+            return []
         if at == "rev":
-            return []          # revived then re-deleted: stays detached
+            # revived then re-deleted: stays detached under the
+            # ORIGINAL identity; paired revs re-point there
+            if erased is not None and "did" in bm:
+                u, b0 = bm["did"]
+                for j in range(am["n"]):
+                    erased[(u, b0 + j)] = (
+                        "rev", am["rev"], am["idx"] + j
+                    )
+            return []
         if at == "mod":
             return [{**bm, "n": 1}]  # changed then deleted: net delete
     if bt == "mod":
@@ -565,6 +693,11 @@ def _mute(cpiece: Mark, om: Mark, offset: int) -> Mark:
 def _rebase_marks(c_marks: MarkList, o_marks: MarkList) -> MarkList:
     c = _Queue(c_marks)
     out: MarkList = []
+    # (uid, idx) of change-del nodes muted by an over-delete -> the
+    # over-delete's identity for that node; a rev half paired to them
+    # (a move whose source was concurrently deleted) mutes too —
+    # DELETE WINS — keyed so undoing the over-delete unmutes the move
+    dead: dict = {}
     for om in copy.deepcopy(o_marks):
         t = om["t"]
         if t == "tomb":
@@ -591,7 +724,14 @@ def _rebase_marks(c_marks: MarkList, o_marks: MarkList) -> MarkList:
             if t == "skip":
                 out.append(cpiece)
             elif t == "del":
-                out.append(_mute(cpiece, om, total - need))
+                offset = total - need
+                if cpiece["t"] == "del" and "did" in cpiece:
+                    u, base = cpiece["did"]
+                    for i in range(k):
+                        dead[(u, base + i)] = _del_identity(
+                            om, offset + i
+                        )
+                out.append(_mute(cpiece, om, offset))
             elif t == "mod":
                 if cpiece["t"] == "mod":
                     out.append(_rebase_mod(cpiece, om))
@@ -602,7 +742,56 @@ def _rebase_marks(c_marks: MarkList, o_marks: MarkList) -> MarkList:
             need -= k
     while not c.empty:
         out.append(c.pop())
+    if dead:
+        out = _mute_paired_revs(out, dead)
     return normalize(out)
+
+
+def _mute_paired_revs(marks: MarkList, dead: dict) -> MarkList:
+    """Mute rev pieces whose source nodes an over-delete took (the
+    rev half of a move whose del half just muted): tomb them under the
+    over-delete's identity so a revive of THOSE nodes unmutes the move
+    too."""
+    out: MarkList = []
+    for m in marks:
+        if m["t"] != "rev":
+            out.append(m)
+            continue
+        i = 0
+        while i < m["n"]:
+            key = dead.get((m["rev"], m["idx"] + i))
+            j = i
+            while j < m["n"] and (
+                (dead.get((m["rev"], m["idx"] + j)) is None)
+                == (key is None)
+            ):
+                j += 1
+            piece = {**m, "n": j - i, "idx": m["idx"] + i}
+            if "mods" in m:
+                sel = {str(int(o) - i): mm
+                       for o, mm in m["mods"].items()
+                       if i <= int(o) < j}
+                if sel:
+                    piece["mods"] = sel
+                else:
+                    piece.pop("mods", None)
+            if key is None:
+                out.append(piece)
+            else:
+                # per-node tombs: the over-delete identities need not
+                # be contiguous across the run
+                for off in range(i, j):
+                    p1 = {**piece, "n": 1, "idx": m["idx"] + off}
+                    mm = (m.get("mods") or {}).get(str(off))
+                    if mm is not None:
+                        p1["mods"] = {"0": mm}
+                    else:
+                        p1.pop("mods", None)
+                    out.append(tomb(
+                        1, dead[(m["rev"], m["idx"] + off)], p1
+                    ))
+            i = j
+    return out
 
 
 def _tomb_match_offset(cm: Mark, ident: Optional[list],

@@ -64,6 +64,12 @@ class EditableField:
     def delete(self, index: int, count: int = 1) -> None:
         self._tree.delete_nodes(self._path, index, count)
 
+    def move(self, src: int, dst: int, *, count: int = 1) -> None:
+        """count is keyword-only: SharedTree.move_nodes orders
+        (src, count, dst) and a positionally transposed call would be
+        valid-but-wrong."""
+        self._tree.move_nodes(self._path, src, count, dst)
+
     def __delitem__(self, i) -> None:
         if isinstance(i, slice):
             start, stop, step = i.indices(len(self))

@@ -60,9 +60,45 @@ class Forest:
     # ------------------------------------------------------------------
 
     def apply(self, changes: FieldChanges, revision: Any) -> None:
-        """Apply a changeset, capturing repair data under ``revision``."""
+        """Apply a changeset, capturing repair data under
+        ``revision``. Capture runs as a PRE-PASS over the whole
+        changeset so a rev may reference a del of the SAME changeset
+        regardless of mark order — that is exactly a move
+        (changeset.move: detach+revive pair)."""
         counter = [0]
+        self._capture_fields(self.fields, changes, revision, counter)
+        counter[0] = 0
         self._apply_fields(self.fields, changes, revision, counter)
+
+    def _capture_fields(self, fields: dict, changes: FieldChanges,
+                        revision: Any, counter: list) -> None:
+        for key in sorted(changes):
+            self._capture_marks(
+                fields.get(key, []), changes[key], revision, counter
+            )
+
+    def _capture_marks(self, seq: list, marks: MarkList,
+                       revision: Any, counter: list) -> None:
+        pos = 0
+        for m in marks:
+            t = m["t"]
+            if t == "del":
+                u, base = m["did"] if "did" in m \
+                    else (revision, counter[0])
+                for i, nd in enumerate(seq[pos:pos + m["n"]]):
+                    self.repair[(u, base + i)] = copy.deepcopy(nd)
+                counter[0] += m["n"]
+                pos += m["n"]
+            elif t == "skip":
+                pos += m["n"]
+            elif t == "mod":
+                if m.get("fields") and pos < len(seq):
+                    self._capture_fields(
+                        seq[pos].get("fields", {}), m["fields"],
+                        revision, counter,
+                    )
+                pos += 1
+            # ins / rev / tomb consume no input
 
     def _apply_fields(self, fields: dict, changes: FieldChanges,
                       revision: Any, counter: list) -> None:
@@ -76,14 +112,9 @@ class Forest:
         hooks attached."""
 
         def on_del(m, nodes):
-            # repair keys follow the del's birth identity when stamped
-            # (changeset.stamp), so every replica keys the same nodes
-            # identically; unstamped dels fall back to (application
-            # revision, walk counter) — the order changeset.invert
-            # assigns.
-            u, base = m["did"] if "did" in m else (revision, counter[0])
-            for i, nd in enumerate(nodes):
-                self.repair[(u, base + i)] = copy.deepcopy(nd)
+            # capture already ran in the pre-pass (Forest.apply); the
+            # hook only keeps the unstamped-del counter in step with
+            # the canonical walk order
             counter[0] += m["n"]
 
         def on_rev(m):

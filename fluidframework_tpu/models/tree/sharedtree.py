@@ -213,6 +213,16 @@ class SharedTree(SharedObject, EventEmitter):
         marks = ([cs.skip(index)] if index else []) + [cs.dele(count)]
         self._apply_local(wrap_path(path, marks))
 
+    def move_nodes(self, path: Sequence, src: int, count: int,
+                   dst: int) -> None:
+        """Move ``count`` nodes within the field at ``path`` from
+        input position ``src`` to input position ``dst`` (expressed
+        against the CURRENT view; dst outside the moved range).
+        Same-field, so the stored schema's type/cardinality
+        constraints are unaffected. Concurrency: delete wins — see
+        changeset.move."""
+        self._apply_local(wrap_path(path, cs.move(src, count, dst)))
+
     def set_value(self, path: Sequence, index: int, value: Any) -> None:
         seq = self.get_field(path)
         old = seq[index].get("value") if index < len(seq) else None

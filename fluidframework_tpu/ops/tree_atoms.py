@@ -17,12 +17,18 @@ Atom kinds:
         as the merge kernel)
   DEL   detach the single node at ``pos``
   SET   value-set on the single node at ``pos``
+  MOV   move the single node at ``pos`` to anchor position ``pos2``
+        (the tensor form of changeset.move's paired detach+revive;
+        delete-wins muting matches the scalar algebra)
 ``muted`` marks atoms whose target a rebase-over deleted (the scalar
 algebra's tombstones); they ride along as zero-length anchors.
 
-Device-inexpressible marks (rev/tomb inputs, nested ``fields``) raise
-``ValueError`` — callers fall back to the scalar path, the same
-eviction discipline the merge sidecar uses.
+Device-inexpressible marks (unpaired rev, tomb inputs, nested
+``fields``) raise ``ValueError`` — callers fall back to the scalar
+path, the same eviction discipline the merge sidecar uses. MOV is
+supported in the changeset BEING REBASED; a move in the rebased-OVER
+trunk stays host-path (its follow-the-move semantics are scalar-only
+for now).
 """
 from __future__ import annotations
 
@@ -34,6 +40,7 @@ ATOM_NOP = 0
 ATOM_INS = 1
 ATOM_DEL = 2
 ATOM_SET = 3
+ATOM_MOV = 4
 
 DEFAULT_ATOMS = 64
 
@@ -43,33 +50,54 @@ class TreeAtoms(NamedTuple):
 
     kind: Any
     pos: Any
-    n: Any      # INS width; DEL/SET are unit
+    n: Any      # INS width; DEL/SET/MOV are unit
     muted: Any
+    pos2: Any   # MOV destination anchor (input coords); else 0
 
     @property
     def atoms(self) -> int:
         return self.kind.shape[-1]
 
 
-def encode_changeset(marks: list, width: int = DEFAULT_ATOMS
-                     ) -> tuple[dict, list]:
+def encode_changeset(marks: list, width: int = DEFAULT_ATOMS,
+                     allow_moves: bool = True) -> tuple[dict, list]:
     """Mark list (one field) -> single-doc atom arrays + host content
-    table (content[i] set for INS atoms, None otherwise)."""
+    table (content[i] set for INS atoms, None otherwise).
+
+    ``allow_moves=False`` is for changesets used in the rebased-OVER
+    role: the kernel's rebase math does not yet model an over-move's
+    follow-the-move shifts, so such trunks must take the host path
+    (this raises, callers fall back)."""
     kind = np.zeros(width, np.int32)
     pos = np.zeros(width, np.int32)
     n = np.zeros(width, np.int32)
     muted = np.zeros(width, np.int32)
+    pos2 = np.zeros(width, np.int32)
     content: list = [None] * width
     a = 0
     p = 0
 
-    def put(k, at, cnt, payload=None, mute=0):
+    def put(k, at, cnt, payload=None, mute=0, at2=0):
         nonlocal a
         if a >= width:
             raise ValueError(f"changeset exceeds {width} atoms")
         kind[a], pos[a], n[a], muted[a] = k, at, cnt, mute
+        pos2[a] = at2
         content[a] = payload
         a += 1
+
+    # first pass: input positions of paired move halves (del with a
+    # did that a rev in the same list references)
+    move_dsts: dict = {}
+    q = 0
+    for m in marks:
+        if m["t"] == "rev":
+            move_dsts.setdefault(
+                (m["rev"], m["idx"]), []
+            ).append((q, m["n"]))
+        q += in_len_of(m)
+
+    matched_revs = set()
 
     for m in marks:
         t = m["t"]
@@ -78,8 +106,19 @@ def encode_changeset(marks: list, width: int = DEFAULT_ATOMS
         elif t == "ins":
             put(ATOM_INS, p, len(m["content"]), list(m["content"]))
         elif t == "del":
-            for i in range(m["n"]):
-                put(ATOM_DEL, p + i, 1)
+            pair = move_dsts.get(tuple(m.get("did") or ()), None)
+            if pair is not None and not allow_moves:
+                raise ValueError(
+                    "move in a rebased-over changeset: host path only"
+                )
+            if pair is not None and pair[0][1] == m["n"]:
+                dst, _k = pair[0]
+                matched_revs.add(tuple(m["did"]))
+                for i in range(m["n"]):
+                    put(ATOM_MOV, p + i, 1, at2=dst)
+            else:
+                for i in range(m["n"]):
+                    put(ATOM_DEL, p + i, 1)
             p += m["n"]
         elif t == "mod":
             if m.get("fields"):
@@ -88,12 +127,30 @@ def encode_changeset(marks: list, width: int = DEFAULT_ATOMS
                 put(ATOM_SET, p, 1, m["value"])
             # a valueless, fieldless mod is skip(1) (cs.normalize)
             p += 1
-        else:  # rev / tomb: repair-store machinery stays host-side
+        elif t == "rev":
+            if (m["rev"], m["idx"]) in move_dsts and "mods" not in m:
+                continue  # the paired del emitted the MOV atoms
+            raise ValueError("unpaired revive: host path only")
+        else:  # tomb: repair-store machinery stays host-side
             raise ValueError(f"device-inexpressible mark {t!r}")
+    # every rev we skipped must actually have been matched by its del
+    for key, entries in move_dsts.items():
+        if key not in matched_revs:
+            raise ValueError("unpaired revive: host path only")
     return (
-        {"kind": kind, "pos": pos, "n": n, "muted": muted},
+        {"kind": kind, "pos": pos, "n": n, "muted": muted,
+         "pos2": pos2},
         content,
     )
+
+
+def in_len_of(m: dict) -> int:
+    t = m["t"]
+    if t in ("skip", "del"):
+        return m["n"]
+    if t == "mod":
+        return 1
+    return 0
 
 
 def stack_changesets(encoded: list[dict]) -> TreeAtoms:
@@ -103,19 +160,24 @@ def stack_changesets(encoded: list[dict]) -> TreeAtoms:
         pos=np.stack([e["pos"] for e in encoded]),
         n=np.stack([e["n"] for e in encoded]),
         muted=np.stack([e["muted"] for e in encoded]),
+        pos2=np.stack([e["pos2"] for e in encoded]),
     )
 
 
 def atoms_to_marks(atoms_np: dict, content: list) -> list:
     """Decode one doc's (rebased) atoms back into a normalized mark
     list in the post-rebase input coordinates. Muted atoms drop (their
-    effect is nil; unmuting via revive is host-path work)."""
+    effect is nil; unmuting via revive is host-path work). MOV atoms
+    decode back into paired del+rev marks (synthetic identities)."""
     rows = []
     for i in range(len(atoms_np["kind"])):
         k = int(atoms_np["kind"][i])
         if k == ATOM_NOP or int(atoms_np["muted"][i]):
             continue
         rows.append((int(atoms_np["pos"][i]), k != ATOM_INS, i, k))
+        if k == ATOM_MOV:
+            # destination half: an attach row at pos2
+            rows.append((int(atoms_np["pos2"][i]), False, i, -k))
     rows.sort(key=lambda r: (r[0], r[1], r[2]))
     marks: list = []
     cursor = 0
@@ -126,6 +188,13 @@ def atoms_to_marks(atoms_np: dict, content: list) -> list:
         if k == ATOM_INS:
             marks.append({"t": "ins",
                           "content": list(content[i] or [])})
+        elif k == -ATOM_MOV:
+            marks.append({"t": "rev", "n": 1,
+                          "rev": "__mov__", "idx": i})
+        elif k == ATOM_MOV:
+            marks.append({"t": "del", "n": 1,
+                          "did": ["__mov__", i]})
+            cursor += 1
         elif k == ATOM_DEL:
             if (marks and marks[-1]["t"] == "del"):
                 marks[-1]["n"] += 1
@@ -142,7 +211,13 @@ def atoms_to_marks(atoms_np: dict, content: list) -> list:
 def apply_atoms(seq: list, atoms_np: dict, content: list) -> list:
     """Apply one doc's atoms to a node list (positions are input
     coordinates of ``seq``) — the host applier for parity checks and
-    forest updates."""
-    from ..models.tree.changeset import walk_apply
+    forest updates. Applies through a throwaway Forest so decoded
+    move pairs (del+rev) resolve via the same-changeset repair
+    pre-pass."""
+    import copy
 
-    return walk_apply(seq, atoms_to_marks(atoms_np, content))
+    from ..models.tree.forest import Forest
+
+    f = Forest({"root": copy.deepcopy(seq)})
+    f.apply({"root": atoms_to_marks(atoms_np, content)}, "__atoms__")
+    return f.content()["root"]

@@ -29,21 +29,30 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .tree_atoms import ATOM_DEL, ATOM_INS, ATOM_SET, TreeAtoms
+from .tree_atoms import (
+    ATOM_DEL,
+    ATOM_INS,
+    ATOM_MOV,
+    ATOM_SET,
+    TreeAtoms,
+)
 
 
 def _rebase_one(c: TreeAtoms, o: TreeAtoms) -> TreeAtoms:
     """Rebase one doc's changeset atoms over one doc's ``over`` atoms
-    (shared input coordinates)."""
+    (shared input coordinates). MOV atoms in ``c`` carry a node target
+    (pos = source) AND an attach anchor (pos2 = destination); moves in
+    ``o`` are rejected at encode time (host path)."""
     live_o = o.muted == 0
     o_ins = (o.kind == ATOM_INS) & live_o
     o_del = (o.kind == ATOM_DEL) & live_o
 
     cpos = c.pos[:, None]          # [A, 1]
     opos = o.pos[None, :]          # [1, A]
-    node_target = ((c.kind == ATOM_DEL) | (c.kind == ATOM_SET)) & (
-        c.muted == 0
-    )
+    node_target = (
+        (c.kind == ATOM_DEL) | (c.kind == ATOM_SET)
+        | (c.kind == ATOM_MOV)
+    ) & (c.muted == 0)
 
     # O-insert widths shifting each C atom. Node targets shift when the
     # insert lands at-or-before their node (an insert AT index p pushes
@@ -63,14 +72,32 @@ def _rebase_one(c: TreeAtoms, o: TreeAtoms) -> TreeAtoms:
         (o_del[None, :] & strictly_before).astype(jnp.int32), axis=1
     )
 
-    # target node deleted by O -> mute (the scalar algebra's tombstone)
+    # target node deleted by O -> mute (the scalar algebra's
+    # tombstone; for MOV this is delete-wins: both halves mute)
     hit = jnp.any(o_del[None, :] & (opos == cpos), axis=1)
     muted = jnp.where(node_target & hit, 1, c.muted)
 
     pos = jnp.where(
         c.kind == 0, c.pos, c.pos + ins_shift - del_shift
     )
-    return TreeAtoms(kind=c.kind, pos=pos, n=c.n, muted=muted)
+
+    # the MOV destination anchor rebases like an attach (strictly-
+    # before inserts shift it; earlier deletes collapse it left)
+    cdst = c.pos2[:, None]
+    dst_ins_shift = jnp.sum(
+        jnp.where((opos < cdst) & o_ins[None, :], o.n[None, :], 0),
+        axis=1,
+    )
+    dst_del_shift = jnp.sum(
+        (o_del[None, :] & (opos < cdst)).astype(jnp.int32), axis=1
+    )
+    pos2 = jnp.where(
+        c.kind == ATOM_MOV,
+        c.pos2 + dst_ins_shift - dst_del_shift,
+        c.pos2,
+    )
+    return TreeAtoms(kind=c.kind, pos=pos, n=c.n, muted=muted,
+                     pos2=pos2)
 
 
 def rebase_atoms_impl(c: TreeAtoms, o: TreeAtoms) -> TreeAtoms:

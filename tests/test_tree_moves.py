@@ -1,0 +1,280 @@
+"""SharedTree moves: the detach+revive pairing (changeset.move).
+
+Reference parity target: sequence-field MoveOut/MoveIn
+(feature-libraries/sequence-field/format.ts) under the ChangeRebaser
+laws (core/rebase/rebaser.ts:138-170). Semantics choice (documented on
+changeset.move): DELETE WINS on a concurrent source delete — both
+halves mute, and undoing that delete unmutes the whole move.
+
+Covers: algebra laws fuzzed WITH moves, EditManager convergence with
+concurrent moves, directed move-vs-delete / move-vs-move scenarios,
+and the end-to-end SharedTree surface (incl. transactions/anchors).
+"""
+import random
+
+import pytest
+
+from fluidframework_tpu.models.tree import changeset as cs
+from fluidframework_tpu.models.tree import node
+from fluidframework_tpu.models.tree.forest import Forest
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+
+def mk_nodes(n, base=0):
+    return [node("n", value=base + i) for i in range(n)]
+
+
+def applied(base, *changes_revs):
+    f = Forest({"root": [dict(x) for x in base]})
+    for change, rev in changes_revs:
+        f.apply(change, rev)
+    return f.content()["root"]
+
+
+def rand_change_with_moves(rng, base_nodes, uid):
+    """Random mark list over ins/del/mod/MOVE, stamped."""
+    base_len = len(base_nodes)
+    marks = []
+    remaining = base_len
+    pos = 0
+    for _ in range(3):
+        if remaining <= 0:
+            break
+        gap = rng.randint(0, remaining - 1) if remaining > 1 else 0
+        if gap:
+            marks.append(cs.skip(gap))
+            remaining -= gap
+            pos += gap
+        roll = rng.random()
+        if roll < 0.3:
+            marks.append(cs.ins(mk_nodes(rng.randint(1, 2), 500)))
+        elif roll < 0.55 and remaining > 0:
+            k = rng.randint(1, min(2, remaining))
+            marks.append(cs.dele(k))
+            remaining -= k
+            pos += k
+        elif roll < 0.8 and remaining > 0:
+            marks.append(cs.mod(value={
+                "new": rng.randint(100, 199),
+                "old": base_nodes[pos].get("value"),
+            }))
+            remaining -= 1
+            pos += 1
+        else:
+            break  # moves are authored standalone below
+    change = cs.normalize_fields({"root": marks})
+    if rng.random() < 0.6 and base_len >= 2:
+        # standalone move changeset against the same base
+        src = rng.randint(0, base_len - 1)
+        count = rng.randint(1, min(2, base_len - src))
+        choices = [d for d in range(base_len + 1)
+                   if d <= src or d >= src + count]
+        dst = rng.choice(choices)
+        change = {"root": cs.move(src, count, dst)}
+    return cs.stamp(change, uid)
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_move_rebase_laws(seed):
+    """rebase(a, compose(b, c)) == rebase(rebase(a, b), c) and the
+    identity laws, with moves in all three changesets."""
+    rng = random.Random(seed * 17 + 3)
+    base = mk_nodes(6)
+    a = rand_change_with_moves(rng, base, f"A{seed}")
+    b = rand_change_with_moves(rng, base, f"B{seed}")
+    fb = Forest({"root": [dict(x) for x in base]})
+    fb.apply(b, "b")
+    c = rand_change_with_moves(
+        rng, fb.content()["root"], f"C{seed}"
+    )
+    fb.apply(c, "c")  # fb now holds base+b+c WITH their repair data
+
+    lhs = cs.rebase(a, cs.compose([b, c]))
+    rhs = cs.rebase(cs.rebase(a, b), c)
+    fl, fr = fb.clone(), fb.clone()
+    fl.apply(lhs, "L")
+    fr.apply(rhs, "R")
+    assert fl.content()["root"] == fr.content()["root"]
+
+    assert cs.rebase(a, cs.compose([])) == a
+    assert cs.rebase(cs.compose([]), a) == {}
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_move_invert_roundtrip(seed):
+    """compose([a, invert(a)]) applies as a no-op — a move's inverse
+    is the move back."""
+    rng = random.Random(seed * 29 + 11)
+    base = mk_nodes(6)
+    a = rand_change_with_moves(rng, base, f"A{seed}")
+    inv = cs.invert(a, f"inv{seed}")
+    out = applied(base, (a, "a"), (inv, "inv"))
+    assert out == base
+
+
+def _session():
+    s = ContainerSession(["A", "B"])
+    for cid in ("A", "B"):
+        s.runtime(cid).create_datastore("d").create_channel(
+            "sharedtree", "t")
+    s.process_all()
+    return (s, s.runtime("A").get_datastore("d").get_channel("t"),
+            s.runtime("B").get_datastore("d").get_channel("t"))
+
+
+def test_move_basic_and_converges():
+    s, a, b = _session()
+    a.insert_nodes(("root",), 0, mk_nodes(4))
+    s.process_all()
+    a.move_nodes(("root",), 0, 2, 4)  # [0,1,2,3] -> [2,3,0,1]
+    s.process_all()
+    s.assert_converged()
+    assert [n["value"] for n in b.get_field(("root",))] == [2, 3, 0, 1]
+
+
+def test_move_vs_concurrent_delete_delete_wins():
+    s, a, b = _session()
+    a.insert_nodes(("root",), 0, mk_nodes(4))
+    s.process_all()
+    b.delete_nodes(("root",), 0, 2)     # sequences first
+    a.move_nodes(("root",), 0, 2, 4)    # concurrent move of the same
+    s.flush("B")
+    s.flush("A")
+    s.process_all()
+    s.assert_converged()
+    assert [n["value"] for n in b.get_field(("root",))] == [2, 3]
+
+
+def test_move_vs_concurrent_delete_then_undo():
+    """Undoing the winning delete restores the nodes at their SOURCE:
+    the muted move is sequenced history by then, and unmute-through-
+    tombstones applies only to changes still being rebased (pending /
+    branch changes), never retroactively to the trunk. All replicas
+    agree."""
+    s, a, b = _session()
+    a.insert_nodes(("root",), 0, mk_nodes(4))
+    s.process_all()
+    b.delete_nodes(("root",), 0, 2)
+    a.move_nodes(("root",), 0, 2, 4)
+    s.flush("B")
+    s.flush("A")
+    s.process_all()
+    s.assert_converged()
+    # b undoes its delete (inverse changeset via the DDS escape hatch)
+    em = b._em
+    del_commit = [c for c in em.trunk
+                  if c.session_id == "B"][-1]
+    b.apply_changeset(cs.invert(del_commit.changes, "undo"))
+    s.process_all()
+    s.assert_converged()
+    assert [n["value"] for n in a.get_field(("root",))] == [0, 1, 2, 3]
+
+
+def test_concurrent_moves_of_same_nodes():
+    """Two clients move the same node to different places: the
+    earlier-sequenced move detaches it; the later move's halves mute
+    (its source is gone — same delete-wins rule) and the node lands at
+    the first mover's destination."""
+    s, a, b = _session()
+    a.insert_nodes(("root",), 0, mk_nodes(4))
+    s.process_all()
+    a.move_nodes(("root",), 0, 1, 4)
+    b.move_nodes(("root",), 0, 1, 2)
+    s.flush("A")
+    s.flush("B")
+    s.process_all()
+    s.assert_converged()
+    assert sorted(n["value"] for n in a.get_field(("root",))) == \
+        [0, 1, 2, 3]
+
+
+def test_move_inside_transaction_with_anchor():
+    s, a, b = _session()
+    a.insert_nodes(("root",), 0, mk_nodes(5))
+    s.process_all()
+    anchor = a.track_anchor(("root",), 3)
+    with a.transaction():
+        a.move_nodes(("root",), 0, 2, 5)  # [2,3,4,0,1]
+        a.set_value(("root",), 4, 99)
+    s.process_all()
+    s.assert_converged()
+    # post-move view [2,3,4,0,1]; set_value(4) targets the node "1"
+    assert [n["value"] for n in b.get_field(("root",))] == \
+        [2, 3, 4, 0, 99]
+    loc = a.locate_anchor(anchor)
+    assert loc is not None
+    assert a.get_field(("root",))[loc[-1]]["value"] == 3
+
+
+def test_editable_move():
+    s, a, b = _session()
+    items = a.editable().field("root")
+    items.insert(0, mk_nodes(3))
+    s.process_all()
+    items.move(0, 3)
+    s.process_all()
+    s.assert_converged()
+    assert [n["value"] for n in b.get_field(("root",))] == [1, 2, 0]
+
+def test_transaction_insert_then_move_squashes_correctly():
+    """Composing [insert, move-of-the-inserted] (transaction squash)
+    must not orphan the move's rev half into repair-missing nodes
+    (code-review r3, reproduced): the net effect is an insert at the
+    destination."""
+    s, a, b = _session()
+    a.insert_nodes(("root",), 0, mk_nodes(2, 10))
+    s.process_all()
+    with a.transaction():
+        a.insert_nodes(("root",), 0, mk_nodes(2, 50))  # [50,51,10,11]
+        a.move_nodes(("root",), 0, 2, 4)               # [10,11,50,51]
+    s.process_all()
+    s.assert_converged()
+    assert [n["value"] for n in b.get_field(("root",))] == \
+        [10, 11, 50, 51]
+
+
+def test_two_moves_same_geometry_different_fields():
+    """Default pair tokens must be unique: two moves with identical
+    (src, count, dst) in different fields of one changeset must not
+    cross-wire their pairings (code-review r3, reproduced)."""
+    change = {
+        "a": cs.move(0, 1, 2),
+        "b": cs.move(0, 1, 2),
+    }
+    cs.stamp(change, "u1")
+    f = Forest({
+        "a": mk_nodes(2, 0),     # values [0, 1]
+        "b": mk_nodes(2, 100),   # values [100, 101]
+    })
+    f.apply(change, "r1")
+    out = f.content()
+    assert [n["value"] for n in out["a"]] == [1, 0]
+    assert [n["value"] for n in out["b"]] == [101, 100]
+
+
+def test_anchor_follows_move():
+    """An anchor on a moved node follows it to the destination instead
+    of dying (anchorSet.ts move semantics; code-review r3,
+    reproduced)."""
+    s, a, _b = _session()
+    a.insert_nodes(("root",), 0, mk_nodes(4))
+    s.process_all()
+    anchor = a.track_anchor(("root",), 0)
+    a.move_nodes(("root",), 0, 1, 4)  # [1,2,3,0]
+    loc = a.locate_anchor(anchor)
+    assert loc is not None
+    assert a.get_field(("root",))[loc[-1]]["value"] == 0
+
+
+def test_trunk_move_rejected_by_kernel_encoder():
+    """A move in the rebased-OVER role must take the host path: the
+    kernel's rebase math does not model follow-the-move shifts
+    (code-review r3)."""
+    import pytest as _pytest
+
+    from fluidframework_tpu.ops.tree_atoms import encode_changeset
+
+    marks = cs.stamp({"root": cs.move(0, 1, 3)}, "u")["root"]
+    encode_changeset(marks)  # fine in the rebased role
+    with _pytest.raises(ValueError, match="host path"):
+        encode_changeset(marks, allow_moves=False)
