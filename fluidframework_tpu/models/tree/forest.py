@@ -92,10 +92,16 @@ class Forest:
             elif t == "skip":
                 pos += m["n"]
             elif t == "mod":
-                if m.get("fields") and pos < len(seq):
+                if m.get("fields"):
+                    # recurse even when pos is past the end of the
+                    # field (the apply walk mods a dummy node there):
+                    # nested dels must still consume counter slots or
+                    # the pre-pass keys desynchronize from the walk's
+                    # — and from changeset.invert's — del numbering
+                    sub = seq[pos].get("fields", {}) \
+                        if pos < len(seq) else {}
                     self._capture_fields(
-                        seq[pos].get("fields", {}), m["fields"],
-                        revision, counter,
+                        sub, m["fields"], revision, counter,
                     )
                 pos += 1
             # ins / rev / tomb consume no input

@@ -37,7 +37,12 @@ import os
 import jax
 import jax.numpy as jnp
 
-from .merge_step import fused_step, state_to_table, table_to_state
+from .merge_step import (
+    batch_to_window,
+    fused_step,
+    state_to_table,
+    table_to_state,
+)
 from .segment_table import NOT_REMOVED, OpBatch, SegmentTable
 
 
@@ -52,10 +57,7 @@ def apply_window_impl(table: SegmentTable, batch: OpBatch) -> SegmentTable:
     Kept at 1 elsewhere — CPU tests would only pay extra compile.
     """
     st = table_to_state(table)
-    ops_wd = {
-        f: jnp.swapaxes(getattr(batch, f), 0, 1)[..., None]
-        for f in batch._fields
-    }
+    ops_wd = batch_to_window(batch)
 
     def step(carry, op):
         return fused_step(carry, op), None

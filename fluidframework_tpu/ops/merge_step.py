@@ -143,15 +143,67 @@ def _at(arr, idx, j):
     )
 
 
+class AxisPrims:
+    """The segment-axis primitives ``fused_step`` is generic over.
+
+    Every slot-axis-global operation the step performs goes through
+    this seam, so the same step function runs (a) single-device on a
+    full table, (b) inside a Pallas kernel body (ladder cumsum), and
+    (c) under ``shard_map`` with the SEGMENT axis sharded across
+    devices — the long-document sequence-parallel path (SURVEY §5.7),
+    where these become cross-device collectives
+    (parallel/seq_shard.py).
+    """
+
+    def __init__(self, *, iota_j=None, excl_cumsum=None, shift_right=None,
+                 shift_right_many=None, first_true=None, at=None,
+                 total=None, global_capacity=None):
+        self.iota_j = iota_j or (
+            lambda D, C: lax.broadcasted_iota(jnp.int32, (D, C), 1))
+        self.excl_cumsum = excl_cumsum or _excl_cumsum_native
+        self.shift_right = shift_right or _shift_right
+        # batched variant: shift a whole family of same-shape arrays at
+        # once, so collective implementations pay ONE boundary exchange
+        # per shift distance instead of one per field
+        self.shift_right_many = shift_right_many or (
+            lambda arrs, k: [self.shift_right(a, k) for a in arrs])
+        self.first_true = first_true or _first_true
+        self.at = at or _at
+        # global visible-length total [D,1]; default = last inclusive
+        # prefix (exact integer sum, == jnp.sum(vlen))
+        self.total = total or (lambda vlen, incl: incl[..., -1:])
+        # capacity of the FULL (logical) table; equals the local shape
+        # except under sequence sharding
+        self.global_capacity = global_capacity or (lambda C: C)
+
+
+LOCAL_PRIMS = AxisPrims()
+
+
+def batch_to_window(batch) -> dict:
+    """OpBatch [docs, window] -> per-step op dict layout [window, docs,
+    1] consumed by lax.scan over fused_step — the single definition of
+    the op-window layout contract (shared by the XLA executor and the
+    sequence-sharded path)."""
+    return {
+        f: jnp.swapaxes(getattr(batch, f), 0, 1)[..., None]
+        for f in batch._fields
+    }
+
+
 def fused_step(st: dict, op: dict,
-               excl_cumsum=_excl_cumsum_native) -> dict:
+               prims: AxisPrims = LOCAL_PRIMS) -> dict:
     """Apply one sequenced op per document (batched over the leading
-    doc axis) to the slot state. Pure jnp; runs under XLA and inside
-    Pallas identically (the prefix-sum implementation is the only
-    knob, and both produce exact integer sums)."""
+    doc axis) to the slot state. Pure jnp; runs under XLA, inside
+    Pallas, and under a sequence-sharded shard_map identically (the
+    AxisPrims implementation is the only knob, and every variant
+    produces exact integer sums)."""
+    _first_true = prims.first_true
+    _at = prims.at
     C = st["length"].shape[-1]
     D = st["length"].shape[0]
-    j = lax.broadcasted_iota(jnp.int32, (D, C), 1)
+    Cg = prims.global_capacity(C)
+    j = prims.iota_j(D, C)
 
     count, min_seq = st["count"], st["min_seq"]
     kind = op["kind"]
@@ -176,9 +228,9 @@ def fused_step(st: dict, op: dict,
     vis = alive & ~below & insert_visible & ~removal_visible
     stop = alive & ~below
     vlen = jnp.where(vis, st["length"], 0)
-    E = excl_cumsum(vlen)
+    E = prims.excl_cumsum(vlen)
     incl = E + vlen
-    total = incl[..., C - 1 : C]
+    total = prims.total(vlen, incl)
 
     # INSERT target: first stop slot with E==p1, or p1 strictly inside
     # (breakTie on the sequenced path: insert before the first
@@ -193,12 +245,12 @@ def fused_step(st: dict, op: dict,
     # at p1 changes no visible lengths, so this matches resolving p2
     # after the first split)
     strict1 = (E < p1) & (p1 < incl)
-    idx1 = _first_true(strict1, j, C)
-    s1 = idx1 < C
+    idx1 = _first_true(strict1, j, Cg)
+    s1 = idx1 < Cg
     off1 = p1 - _at(E, idx1, j)
     strict2 = (E < p2) & (p2 < incl)
-    idx2 = _first_true(strict2, j, C)
-    s2 = idx2 < C
+    idx2 = _first_true(strict2, j, Cg)
+    s2 = idx2 < Cg
     off2 = p2 - _at(E, idx2, j)
     same = s1 & s2 & (idx1 == idx2)
 
@@ -208,7 +260,7 @@ def fused_step(st: dict, op: dict,
     u1 = valid_ins | (is_range & s1)
     u2 = split_ins | (is_range & s2)
     added = u1.astype(jnp.int32) + u2.astype(jnp.int32)
-    overflow_now = (added > 0) & (count + added > C)
+    overflow_now = (added > 0) & (count + added > Cg)
     skip = overflow_now
     u1 = u1 & ~skip
     u2 = u2 & ~skip
@@ -229,11 +281,23 @@ def fused_step(st: dict, op: dict,
     m1 = m == 1
     m2 = m == 2
 
-    def moved(arr):
-        return jnp.where(
-            m2, _shift_right(arr, 2),
-            jnp.where(m1, _shift_right(arr, 1), arr),
-        )
+    # the slot fields all restructure under the same m1/m2 selects, so
+    # shift them as one family (one boundary-exchange collective per
+    # shift distance under sequence sharding); the phase-3 stamp mask
+    # rides along — it is derived from the PRE-op view (phase 1) but
+    # must shift with the restructure like everything else
+    fully_in = vis & (vlen > 0) & (E >= p1) & (incl <= p2)
+    move_names = list(SLOT_FIELDS) + ["_stamp"]
+    arrs = [st[f] for f in SLOT_FIELDS] + [fully_in.astype(jnp.int32)]
+    sh1 = prims.shift_right_many(arrs, 1)
+    sh2 = prims.shift_right_many(arrs, 2)
+    mv = {
+        n: jnp.where(m2, s2, jnp.where(m1, s1, a))
+        for n, a, s1, s2 in zip(move_names, arrs, sh1, sh2)
+    }
+
+    def moved(arr_name):
+        return mv[arr_name]
 
     at_A = u1 & (j == A)
     at_B = u2 & (j == B)
@@ -250,7 +314,7 @@ def fused_step(st: dict, op: dict,
     off1h = jnp.where(is_ins, off_ins, off1)
     len_h2 = off2 - jnp.where(same, off1, 0)
 
-    length = moved(st["length"])
+    length = moved("length")
     length = jnp.where(f_h1, off1h, length)
     length = jnp.where(
         at_A, jnp.where(is_ins, op["length"], len_k1 - off1), length
@@ -262,7 +326,7 @@ def fused_step(st: dict, op: dict,
         length,
     )
 
-    op_off = moved(st["op_off"])
+    op_off = moved("op_off")
     op_off = jnp.where(
         at_A, jnp.where(is_ins, 0, opoff_k1 + off1), op_off
     )
@@ -272,25 +336,24 @@ def fused_step(st: dict, op: dict,
         op_off,
     )
 
-    seq = moved(st["seq"])
+    seq = moved("seq")
     seq = jnp.where(new_at_A, op["seq"], seq)
-    cli = moved(st["client"])
+    cli = moved("client")
     cli = jnp.where(new_at_A, client, cli)
-    removed_seq = moved(st["removed_seq"])
+    removed_seq = moved("removed_seq")
     removed_seq = jnp.where(new_at_A, NOT_REMOVED, removed_seq)
-    removers = moved(st["removers"])
+    removers = moved("removers")
     removers = jnp.where(new_at_A, jnp.uint32(0), removers)
-    op_id = moved(st["op_id"])
+    op_id = moved("op_id")
     op_id = jnp.where(new_at_A, op["op_id"], op_id)
-    is_marker = moved(st["is_marker"])
+    is_marker = moved("is_marker")
     is_marker = jnp.where(new_at_A, op["is_marker"], is_marker)
-    props = [moved(st[f"prop{c}"]) for c in range(PROP_CHANNELS)]
+    props = [moved(f"prop{c}") for c in range(PROP_CHANNELS)]
     props = [jnp.where(new_at_A, 0, p) for p in props]
 
     # ---- phase 3: stamps (mask derived from the pre-op view) ---------
-    fully_in = vis & (vlen > 0) & (E >= p1) & (incl <= p2)
-    # shift the mask as int32: Mosaic cannot pad/select i1 vectors
-    stamp = moved(fully_in.astype(jnp.int32)) != 0
+    # mask shifted as int32: Mosaic cannot pad/select i1 vectors
+    stamp = moved("_stamp") != 0
     stamp = stamp | (at_A & is_range) | (f_h2 & is_range)
     stamp = stamp & is_range & ~skip
 

@@ -30,12 +30,17 @@ from .merge_step import (
     OP_COLS,
     SLOT_FIELDS,
     STATE_FIELDS,
+    AxisPrims,
     _excl_cumsum_ladder,
     fused_step,
     state_to_table,
     table_to_state,
 )
 from .segment_table import KIND_NOOP, NOT_REMOVED, OpBatch, SegmentTable
+
+# Mosaic has no cumsum lowering: the Hillis-Steele ladder is the only
+# non-default primitive the in-kernel step needs
+_LADDER_PRIMS = AxisPrims(excl_cumsum=_excl_cumsum_ladder)
 
 # docs per grid block, sized so 12 resident slot arrays + Mosaic's
 # scoped temporaries (~3x the state, measured: block 128 x cap 1024
@@ -75,7 +80,7 @@ def _kernel(*refs):
             )
             for g in OP_COLS
         }
-        st = fused_step(st, op, excl_cumsum=_excl_cumsum_ladder)
+        st = fused_step(st, op, prims=_LADDER_PRIMS)
         for f in STATE_FIELDS:
             out_refs[f][:] = st[f]
         return 0

@@ -144,13 +144,10 @@ def encode_changeset(marks: list, width: int = DEFAULT_ATOMS,
     )
 
 
-def in_len_of(m: dict) -> int:
-    t = m["t"]
-    if t in ("skip", "del"):
-        return m["n"]
-    if t == "mod":
-        return 1
-    return 0
+# single source of truth for mark input-length: the algebra's in_len
+# (a drift between encoder positions and the algebra would silently
+# corrupt kernel-vs-scalar parity)
+from ..models.tree.changeset import in_len as in_len_of  # noqa: E402
 
 
 def stack_changesets(encoded: list[dict]) -> TreeAtoms:

@@ -8,12 +8,32 @@ from .mesh import (
     scalar_sharding,
     shard_pytree,
 )
+from .seq_shard import (
+    SEQ_AXIS,
+    apply_window_seq_sharded,
+    make_seq_mesh,
+    seq_prims,
+)
+from .distributed import (
+    DistributedConfig,
+    ensure_initialized,
+    local_doc_slice,
+    make_global_mesh,
+)
 
 __all__ = [
     "DOC_AXIS",
+    "DistributedConfig",
+    "SEQ_AXIS",
+    "ensure_initialized",
+    "local_doc_slice",
+    "make_global_mesh",
+    "apply_window_seq_sharded",
     "doc_sharding",
     "global_window_floor",
     "make_mesh",
+    "make_seq_mesh",
     "scalar_sharding",
+    "seq_prims",
     "shard_pytree",
 ]

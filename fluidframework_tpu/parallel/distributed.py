@@ -1,0 +1,118 @@
+"""Multi-host distributed backend (SURVEY §5.8, §2.9 axis 4).
+
+The reference's communication stack is socket.io + Kafka + Redis +
+REST; its scale-out unit is the Kafka partition. The TPU-native
+equivalents live on two planes:
+
+- HOST plane: the networked ingress (service/ingress.py) and the
+  partitioned ordering service (service/partitioning.py) — pure
+  asyncio/TCP, one process per partition group.
+- DEVICE plane: ``jax.distributed`` — every host process joins one
+  global JAX runtime, ``jax.devices()`` becomes the global device set,
+  and collectives ride ICI inside a slice / DCN across slices. Mesh
+  layout policy (the scaling-book recipe): put the DOCUMENT axis
+  across hosts (document lanes are independent — zero cross-host
+  collective traffic, matching the reference where two Kafka
+  partitions never talk), and the SEQUENCE axis (parallel/seq_shard.py
+  — prefix-sum/ppermute collectives every step) INSIDE a host's ICI
+  domain.
+
+Single-process use (tests, the bench chip, local dev) is the default:
+``ensure_initialized`` is a no-op unless a coordinator is configured,
+and every helper degrades to the local device set.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .seq_shard import SEQ_AXIS
+from .mesh import DOC_AXIS
+
+
+@dataclass
+class DistributedConfig:
+    """Read from env (the jax.distributed contract) or passed
+    explicitly. ``coordinator`` empty => single-process mode."""
+
+    coordinator: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+
+    @classmethod
+    def from_env(cls) -> "DistributedConfig":
+        return cls(
+            coordinator=os.environ.get("FFTPU_COORDINATOR")
+            or os.environ.get("JAX_COORDINATOR_ADDRESS"),
+            num_processes=int(os.environ.get("FFTPU_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("FFTPU_PROCESS_ID", "0")),
+        )
+
+
+_initialized = False
+
+
+def ensure_initialized(
+    config: Optional[DistributedConfig] = None,
+) -> bool:
+    """Join the global jax.distributed runtime if (and only if) a
+    multi-process topology is configured. Returns True when running
+    multi-process. Idempotent."""
+    global _initialized
+    cfg = config or DistributedConfig.from_env()
+    if cfg.coordinator is None or cfg.num_processes <= 1:
+        return False
+    if _initialized:
+        return True
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    _initialized = True
+    return True
+
+
+def make_global_mesh(
+    doc_shards: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A (docs, seq) mesh over the GLOBAL device set, laid out so the
+    doc axis crosses hosts (DCN-safe: no collectives) and the seq axis
+    stays within a host's devices (ICI collectives).
+
+    Default policy: doc_shards = number of processes (>= 1), i.e. one
+    document lane per host, each lane sequence-sharded over that
+    host's local chips. Override ``doc_shards`` for more lanes.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if doc_shards is None:
+        doc_shards = max(1, jax.process_count())
+    if n % doc_shards:
+        raise ValueError(
+            f"{n} devices not divisible into {doc_shards} doc lanes"
+        )
+    per_lane = n // doc_shards
+    # order lanes by process so each lane's seq block is host-local
+    devices = sorted(
+        devices, key=lambda d: (d.process_index, d.id)
+    )
+    arr = np.array(devices).reshape(doc_shards, per_lane)
+    return Mesh(arr, (DOC_AXIS, SEQ_AXIS))
+
+
+def local_doc_slice(n_docs: int) -> slice:
+    """The contiguous slice of the global document batch this process
+    owns under the one-lane-per-host layout — the bridge between the
+    host-plane partition (service/partitioning.py routes documents to
+    partitions/hosts) and the device-plane doc axis."""
+    procs = max(1, jax.process_count())
+    pid = jax.process_index()
+    per = -(-n_docs // procs)  # ceil
+    return slice(pid * per, min(n_docs, (pid + 1) * per))

@@ -13,16 +13,32 @@ from .lambdas import (
 )
 from .local_orderer import LocalOrderer
 from .local_server import DeltaConnection, LocalServer
+from .partitioning import (
+    CheckpointManager,
+    FileOrderingQueue,
+    InMemoryOrderingQueue,
+    OrderingQueue,
+    Partition,
+    PartitionedOrderingService,
+    partition_for,
+)
 from .sequencer import DocumentSequencer, TicketResult
 from .tpu_sidecar import TpuMergeSidecar
 
 __all__ = [
     "AlfredServer",
     "BroadcasterLambda",
+    "CheckpointManager",
     "DeltaConnection",
     "DocumentSequencer",
+    "FileOrderingQueue",
+    "InMemoryOrderingQueue",
     "LocalOrderer",
     "LocalServer",
+    "OrderingQueue",
+    "Partition",
+    "PartitionedOrderingService",
+    "partition_for",
     "OpLog",
     "ScribeLambda",
     "ScriptoriumLambda",

@@ -1,0 +1,388 @@
+"""Scale-out partitioning — the kafka-service / document-router
+analogue.
+
+The reference scales the ordering service by sharding DOCUMENTS over
+Kafka partitions: raw ops are produced keyed by document id, each
+partition is consumed by a lambda host that demuxes records to
+per-document lambda instances, and progress is committed as a
+monotonic per-partition offset so a crashed/rebalanced consumer
+resumes exactly where the checkpoint says (at-least-once delivery;
+deli drops below-checkpoint duplicates by clientSequenceNumber).
+
+Reference shapes rebuilt here:
+- ``Partition`` (lambdas-driver/src/kafka-service/partition.ts:26):
+  one consumed queue partition -> lambda, with a CheckpointManager.
+- ``CheckpointManager`` (kafka-service/checkpointManager.ts:10):
+  commit the lowest fully-processed offset, monotonically.
+- ``DocumentLambda``/``DocumentPartition``
+  (document-router/src/{documentLambda.ts:20,documentPartition.ts:20}):
+  demux a partition's record stream to per-document orderers.
+- The queue itself (services-ordering-rdkafka
+  ``RdkafkaConsumer``/``Producer``) becomes an ``OrderingQueue``
+  interface with in-memory and file-backed (durable) impls — the
+  deployment seam where a real broker would plug in.
+
+TPU mapping (SURVEY §2.9 axis 1): a partition is the host-side unit of
+document-parallelism; each partition's documents batch into the same
+TPU sidecar dispatch, and partitions map 1:1 onto mesh doc-axis shards
+(parallel/mesh.py) or onto separate hosts (parallel/distributed.py).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import zlib
+from typing import Any, Callable, Iterator, Optional
+
+from ..protocol.messages import ClientDetail, DocumentMessage, Nack
+from .local_orderer import LocalOrderer
+from .storage import DocumentStorage
+
+
+def partition_for(document_id: str, n_partitions: int) -> int:
+    """Stable document -> partition routing (the Kafka key hash)."""
+    return zlib.crc32(document_id.encode()) % n_partitions
+
+
+# ----------------------------------------------------------------------
+# Ordering queue: the broker seam
+
+
+class QueueRecord:
+    __slots__ = ("offset", "document_id", "payload")
+
+    def __init__(self, offset: int, document_id: str, payload: dict):
+        self.offset = offset
+        self.document_id = document_id
+        self.payload = payload
+
+
+class OrderingQueue:
+    """Partitioned, offset-addressed raw-op transport (the Kafka
+    interface: services-ordering-rdkafka/src/rdkafkaProducer.ts:52,
+    rdkafkaConsumer.ts:37). At-least-once: consumers re-read from the
+    committed offset after a crash."""
+
+    def produce(self, partition: int, document_id: str,
+                payload: dict) -> int:
+        raise NotImplementedError
+
+    def read(self, partition: int, from_offset: int
+             ) -> Iterator[QueueRecord]:
+        raise NotImplementedError
+
+    def committed(self, partition: int) -> int:
+        """Last committed (fully processed) offset, -1 if none."""
+        raise NotImplementedError
+
+    def commit(self, partition: int, offset: int) -> None:
+        raise NotImplementedError
+
+
+class InMemoryOrderingQueue(OrderingQueue):
+    def __init__(self, n_partitions: int):
+        self._logs: list[list[QueueRecord]] = [
+            [] for _ in range(n_partitions)
+        ]
+        self._committed = [-1] * n_partitions
+
+    def produce(self, partition: int, document_id: str,
+                payload: dict) -> int:
+        log = self._logs[partition]
+        rec = QueueRecord(len(log), document_id, payload)
+        log.append(rec)
+        return rec.offset
+
+    def read(self, partition: int, from_offset: int):
+        yield from self._logs[partition][max(0, from_offset):]
+
+    def committed(self, partition: int) -> int:
+        return self._committed[partition]
+
+    def commit(self, partition: int, offset: int) -> None:
+        if offset > self._committed[partition]:
+            self._committed[partition] = offset
+
+
+class FileOrderingQueue(OrderingQueue):
+    """Durable queue: one append-only jsonl per partition + a committed
+    offset file — enough broker semantics (ordered, offset-addressed,
+    survives the process) for single-box deployments and for the
+    crash-restart tests."""
+
+    def __init__(self, root: str, n_partitions: int):
+        self.root = root
+        self.n_partitions = n_partitions
+        os.makedirs(root, exist_ok=True)
+        self._counts = [0] * n_partitions
+        self._committed = [-1] * n_partitions
+        # sequential-read cursor per partition: (record offset, byte
+        # position) of the next unread record, so steady-state pumps
+        # seek instead of rescanning the log from line 0 (O(N^2) over
+        # the log's life otherwise)
+        self._cursor: dict[int, tuple[int, int]] = {}
+        for p in range(n_partitions):
+            if os.path.exists(self._log_path(p)):
+                with open(self._log_path(p)) as f:
+                    self._counts[p] = sum(1 for _ in f)
+            if os.path.exists(self._commit_path(p)):
+                with open(self._commit_path(p)) as f:
+                    self._committed[p] = int(f.read().strip() or -1)
+
+    def _log_path(self, p: int) -> str:
+        return os.path.join(self.root, f"partition-{p}.jsonl")
+
+    def _commit_path(self, p: int) -> str:
+        return os.path.join(self.root, f"partition-{p}.offset")
+
+    def produce(self, partition: int, document_id: str,
+                payload: dict) -> int:
+        offset = self._counts[partition]
+        with open(self._log_path(partition), "a") as f:
+            f.write(json.dumps(
+                {"document_id": document_id, "payload": payload}
+            ) + "\n")
+        self._counts[partition] = offset + 1
+        return offset
+
+    def read(self, partition: int, from_offset: int):
+        path = self._log_path(partition)
+        if not os.path.exists(path):
+            return
+        offset, byte_pos = 0, 0
+        cur = self._cursor.get(partition)
+        if cur is not None and cur[0] <= from_offset:
+            offset, byte_pos = cur
+        with open(path) as f:
+            f.seek(byte_pos)
+            while True:
+                line = f.readline()
+                if not line:
+                    break
+                rec_offset = offset
+                offset += 1
+                self._cursor[partition] = (offset, f.tell())
+                if rec_offset < from_offset:
+                    continue
+                data = json.loads(line)
+                yield QueueRecord(
+                    rec_offset, data["document_id"], data["payload"]
+                )
+
+    def committed(self, partition: int) -> int:
+        return self._committed[partition]
+
+    def commit(self, partition: int, offset: int) -> None:
+        if offset <= self._committed[partition]:
+            return
+        tmp = self._commit_path(partition) + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(offset))
+        os.replace(tmp, self._commit_path(partition))
+        self._committed[partition] = offset
+
+
+# ----------------------------------------------------------------------
+# Checkpoint manager (kafka-service/checkpointManager.ts:10)
+
+
+class CheckpointManager:
+    """Monotonic offset commit over possibly out-of-order record
+    completion: the checkpoint is the highest offset BELOW which every
+    record has completed."""
+
+    def __init__(self, queue: OrderingQueue, partition: int):
+        self._queue = queue
+        self._partition = partition
+        self._inflight: set[int] = set()
+        self._max_seen = queue.committed(partition)
+
+    def starting(self, offset: int) -> None:
+        self._inflight.add(offset)
+        self._max_seen = max(self._max_seen, offset)
+
+    def completed(self, offset: int) -> None:
+        self._inflight.discard(offset)
+        floor = min(self._inflight) - 1 if self._inflight \
+            else self._max_seen
+        if floor >= 0:
+            self._queue.commit(self._partition, floor)
+
+
+# ----------------------------------------------------------------------
+# Per-document demux (document-router)
+
+
+class DocumentPartition:
+    """One document's lambda context inside a partition
+    (document-router/src/documentPartition.ts:20): owns the document's
+    orderer and applies its records in partition order."""
+
+    def __init__(self, document_id: str,
+                 orderer_factory: Callable[[str], LocalOrderer]):
+        self.document_id = document_id
+        self.orderer = orderer_factory(document_id)
+
+    def process(self, payload: dict) -> Optional[Nack]:
+        kind = payload.get("kind", "op")
+        if kind == "join":
+            self.orderer.connect(ClientDetail(**payload["detail"]))
+            return None
+        if kind == "leave":
+            self.orderer.disconnect(payload["client_id"])
+            return None
+        from .ingress import document_message_from_json
+
+        op = document_message_from_json(payload["op"])
+        return self.orderer.submit(payload["client_id"], op)
+
+
+class Partition:
+    """One consumed queue partition (kafka-service/partition.ts:26):
+    reads records from the committed offset, demuxes per document,
+    commits progress through a CheckpointManager."""
+
+    def __init__(self, queue: OrderingQueue, index: int,
+                 orderer_factory: Callable[[str], LocalOrderer],
+                 on_nack: Optional[Callable[[str, Nack], None]] = None):
+        self.queue = queue
+        self.index = index
+        self.checkpoints = CheckpointManager(queue, index)
+        self.documents: dict[str, DocumentPartition] = {}
+        self._orderer_factory = orderer_factory
+        self._next_offset = queue.committed(index) + 1
+        self._on_nack = on_nack
+        self.paused = False
+
+    def document(self, document_id: str) -> DocumentPartition:
+        if document_id not in self.documents:
+            self.documents[document_id] = DocumentPartition(
+                document_id, self._orderer_factory
+            )
+        return self.documents[document_id]
+
+    def pump(self, max_records: Optional[int] = None) -> int:
+        """Process up to ``max_records`` pending records; returns the
+        number processed."""
+        if self.paused:
+            return 0
+        n = 0
+        records = self.queue.read(self.index, self._next_offset)
+        if max_records is not None:
+            # bound the GENERATOR, not the loop: pulling one record
+            # past the limit would advance a file-backed queue's read
+            # cursor beyond _next_offset and force a full-log rescan
+            # on the next pump
+            records = itertools.islice(records, max_records)
+        for rec in records:
+            self.checkpoints.starting(rec.offset)
+            nack = self.document(rec.document_id).process(rec.payload)
+            if nack is not None and self._on_nack is not None:
+                self._on_nack(rec.document_id, nack)
+            self.checkpoints.completed(rec.offset)
+            self._next_offset = rec.offset + 1
+            n += 1
+        return n
+
+
+# ----------------------------------------------------------------------
+# Partition manager
+
+
+class PartitionedOrderingService:
+    """N-partition ordering service: produce raw ops keyed by document,
+    pump partitions to sequence them, resume from checkpoints after a
+    crash. The scale-out seam: each partition is independent, so
+    partitions can live on different processes/hosts with the queue as
+    the only shared substrate (exactly Kafka's role in the
+    reference)."""
+
+    def __init__(self, n_partitions: int = 4,
+                 queue: Optional[OrderingQueue] = None,
+                 durable_dir: Optional[str] = None):
+        self.n_partitions = n_partitions
+        self.durable_dir = durable_dir
+        if queue is None:
+            if durable_dir is not None:
+                queue = FileOrderingQueue(
+                    os.path.join(durable_dir, "queue"), n_partitions
+                )
+            else:
+                queue = InMemoryOrderingQueue(n_partitions)
+        self.queue = queue
+        self.nacks: list[tuple[str, Nack]] = []
+        self.partitions = [
+            Partition(queue, p, self._make_orderer, self._record_nack)
+            for p in range(n_partitions)
+        ]
+
+    def _record_nack(self, document_id: str, nack: Nack) -> None:
+        self.nacks.append((document_id, nack))
+
+    def _make_orderer(self, document_id: str) -> LocalOrderer:
+        storage = None
+        if self.durable_dir is not None:
+            storage = DocumentStorage(
+                os.path.join(self.durable_dir, "docs", document_id)
+            )
+        return LocalOrderer(document_id, storage=storage)
+
+    # -- producer side (alfred -> queue) -------------------------------
+    def partition_of(self, document_id: str) -> int:
+        return partition_for(document_id, self.n_partitions)
+
+    def produce_join(self, document_id: str,
+                     detail: ClientDetail) -> None:
+        import dataclasses
+
+        self.queue.produce(
+            self.partition_of(document_id), document_id,
+            {"kind": "join", "detail": dataclasses.asdict(detail)},
+        )
+
+    def produce_leave(self, document_id: str, client_id: str) -> None:
+        self.queue.produce(
+            self.partition_of(document_id), document_id,
+            {"kind": "leave", "client_id": client_id},
+        )
+
+    def produce_op(self, document_id: str, client_id: str,
+                   op: DocumentMessage) -> None:
+        from .ingress import document_message_to_json
+
+        self.queue.produce(
+            self.partition_of(document_id), document_id,
+            {"kind": "op", "client_id": client_id,
+             "op": document_message_to_json(op)},
+        )
+
+    # -- consumer side --------------------------------------------------
+    def pump(self) -> int:
+        """Drain every partition; returns total records processed."""
+        return sum(p.pump() for p in self.partitions)
+
+    def orderer(self, document_id: str) -> LocalOrderer:
+        p = self.partitions[self.partition_of(document_id)]
+        return p.document(document_id).orderer
+
+    # -- rebalance ------------------------------------------------------
+    def pause_partition(self, index: int) -> None:
+        self.partitions[index].paused = True
+
+    def resume_partition(self, index: int) -> None:
+        """Partition reassignment: a fresh consumer takes the partition
+        over from its committed checkpoint (Kafka consumer-group
+        rebalance). Per-document state is rebuilt from durable deli
+        checkpoints + at-least-once replay — which requires durable
+        storage; without it the rebuilt orderers would silently restart
+        sequencing from 0 while skipping committed records."""
+        if self.durable_dir is None:
+            raise RuntimeError(
+                "partition reassignment requires durable_dir: "
+                "document state cannot be rebuilt from an in-memory "
+                "consumer (unpause the existing partition instead)"
+            )
+        self.partitions[index] = Partition(
+            self.queue, index, self._make_orderer, self._record_nack
+        )

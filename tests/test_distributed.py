@@ -1,0 +1,63 @@
+"""Multi-host backend helpers (§5.8): single-process no-op gating,
+global (docs, seq) mesh layout policy, host<->doc-lane bridging — and
+the seq-sharded kernel running over the global mesh."""
+import jax
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import (
+    apply_window,
+    build_batch,
+    encode_stream,
+    fetch,
+    make_table,
+)
+from fluidframework_tpu.parallel import (
+    DistributedConfig,
+    apply_window_seq_sharded,
+    ensure_initialized,
+    local_doc_slice,
+    make_global_mesh,
+)
+from fluidframework_tpu.testing import FuzzConfig, record_op_stream
+
+
+def test_single_process_is_noop():
+    assert ensure_initialized(DistributedConfig()) is False
+    assert ensure_initialized(
+        DistributedConfig(coordinator=None, num_processes=4)
+    ) is False
+    # coordinator set but single process: still local mode
+    assert ensure_initialized(
+        DistributedConfig(coordinator="host:1234", num_processes=1)
+    ) is False
+
+
+def test_global_mesh_layout():
+    mesh = make_global_mesh()  # 1 process -> 1 doc lane x 8 seq
+    assert mesh.shape == {"docs": 1, "seq": 8}
+    mesh2 = make_global_mesh(doc_shards=4)
+    assert mesh2.shape == {"docs": 4, "seq": 2}
+    with pytest.raises(ValueError, match="not divisible"):
+        make_global_mesh(doc_shards=3)
+
+
+def test_local_doc_slice_single_process():
+    assert local_doc_slice(10) == slice(0, 10)
+
+
+def test_seq_sharded_window_on_global_mesh():
+    mesh = make_global_mesh(doc_shards=2)
+    cases = [
+        record_op_stream(FuzzConfig(n_clients=3, n_steps=90,
+                                    seed=7000 + i))
+        for i in range(4)
+    ]
+    streams = [s for _, s in cases]
+    encs = [encode_stream(s) for s in streams]
+    batch = build_batch(encs)
+    table = make_table(4, 256)
+    ref = fetch(apply_window(table, batch))
+    shd = fetch(apply_window_seq_sharded(table, batch, mesh))
+    for key in ref:
+        np.testing.assert_array_equal(ref[key], shd[key], err_msg=key)

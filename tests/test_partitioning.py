@@ -1,0 +1,155 @@
+"""Scale-out partitioning (lambdas-driver / document-router analogue):
+document->partition routing, offset-checkpointed consumption,
+rebalance, and crash-restart resume through the durable queue.
+"""
+import pytest
+
+from fluidframework_tpu.protocol.messages import (
+    ClientDetail,
+    DocumentMessage,
+    MessageType,
+)
+from fluidframework_tpu.service.partitioning import (
+    CheckpointManager,
+    FileOrderingQueue,
+    InMemoryOrderingQueue,
+    PartitionedOrderingService,
+    partition_for,
+)
+
+
+def op(csn, refseq=0, contents=None):
+    return DocumentMessage(
+        client_sequence_number=csn,
+        reference_sequence_number=refseq,
+        type=MessageType.OPERATION,
+        contents=contents or {"n": csn},
+    )
+
+
+def test_partition_routing_stable_and_covering():
+    ids = [f"doc-{i}" for i in range(64)]
+    first = [partition_for(d, 4) for d in ids]
+    assert first == [partition_for(d, 4) for d in ids]
+    assert set(first) == {0, 1, 2, 3}
+
+
+def test_sequencing_through_partitions():
+    svc = PartitionedOrderingService(n_partitions=4)
+    docs = [f"doc-{i}" for i in range(8)]
+    for d in docs:
+        svc.produce_join(d, ClientDetail(client_id="alice"))
+        for csn in range(1, 6):
+            svc.produce_op(d, "alice", op(csn))
+    processed = svc.pump()
+    assert processed == 8 * 6
+    for d in docs:
+        orderer = svc.orderer(d)
+        # join + 5 ops, contiguous sequence numbers
+        seqs = [m.sequence_number for m in orderer.op_log.read(0)]
+        assert seqs == list(range(1, len(seqs) + 1))
+        assert orderer.sequencer.sequence_number >= 6
+    assert svc.nacks == []
+
+
+def test_nack_surfaces_from_partition():
+    svc = PartitionedOrderingService(n_partitions=2)
+    svc.produce_op("doc", "ghost", op(1))  # never joined
+    svc.pump()
+    assert len(svc.nacks) == 1
+    assert svc.nacks[0][0] == "doc"
+
+
+def test_duplicate_replay_is_idempotent():
+    """At-least-once delivery: re-pumping a partition from an older
+    offset must not re-sequence ops (deli csn dup-drop)."""
+    svc = PartitionedOrderingService(n_partitions=1)
+    svc.produce_join("doc", ClientDetail(client_id="a"))
+    for csn in range(1, 4):
+        svc.produce_op("doc", "a", op(csn))
+    svc.pump()
+    before = svc.orderer("doc").sequencer.sequence_number
+    # simulate redelivery: reset the consumer position, not the commit
+    part = svc.partitions[0]
+    part._next_offset = 1  # replay everything after the join
+    svc.pump()
+    assert svc.orderer("doc").sequencer.sequence_number == before
+
+
+def test_checkpoint_manager_monotonic_out_of_order():
+    q = InMemoryOrderingQueue(1)
+    cm = CheckpointManager(q, 0)
+    cm.starting(0)
+    cm.starting(1)
+    cm.starting(2)
+    cm.completed(1)          # 0 still in flight
+    assert q.committed(0) == -1
+    cm.completed(0)
+    assert q.committed(0) == 1   # 2 still in flight
+    cm.completed(2)
+    assert q.committed(0) == 2
+
+
+def test_rebalance_resumes_from_checkpoint(tmp_path):
+    svc = PartitionedOrderingService(
+        n_partitions=2, durable_dir=str(tmp_path)
+    )
+    svc.produce_join("doc", ClientDetail(client_id="a"))
+    svc.produce_op("doc", "a", op(1))
+    svc.pump()
+    seq_before = svc.orderer("doc").sequencer.sequence_number
+    p = svc.partition_of("doc")
+    svc.pause_partition(p)
+    svc.produce_op("doc", "a", op(2))
+    assert svc.pump() == 0  # paused
+    svc.resume_partition(p)
+    # new consumer: resumes from committed offset; pre-checkpoint
+    # records are not re-read, and the document's orderer restores
+    # from its durable deli checkpoint
+    assert svc.pump() == 1
+    assert svc.orderer("doc").sequencer.sequence_number >= seq_before
+
+
+def test_file_queue_crash_restart(tmp_path):
+    root = str(tmp_path / "svc")
+    svc = PartitionedOrderingService(n_partitions=2, durable_dir=root)
+    svc.produce_join("doc-a", ClientDetail(client_id="a"))
+    svc.produce_join("doc-b", ClientDetail(client_id="b"))
+    for csn in range(1, 5):
+        svc.produce_op("doc-a", "a", op(csn))
+        svc.produce_op("doc-b", "b", op(csn))
+    svc.pump()
+    seq_a = svc.orderer("doc-a").sequencer.sequence_number
+    # ops produced but NOT pumped before the "crash"
+    svc.produce_op("doc-a", "a", op(5))
+    del svc
+
+    svc2 = PartitionedOrderingService(n_partitions=2, durable_dir=root)
+    assert svc2.pump() == 1  # only the unprocessed record replays
+    orderer = svc2.orderer("doc-a")
+    # restart sequences a leave for the checkpointed client, then the
+    # replayed op nacks (connection is gone — client must rejoin), OR
+    # the op lands if the client state survived; either way the op log
+    # stays contiguous and nothing is double-sequenced
+    seqs = [m.sequence_number for m in orderer.op_log.read(0)]
+    assert seqs == list(range(1, len(seqs) + 1))
+    assert orderer.sequencer.sequence_number >= seq_a
+    # the client can rejoin and continue
+    svc2.produce_join("doc-a", ClientDetail(client_id="a"))
+    svc2.produce_op("doc-a", "a", op(1))
+    svc2.pump()
+    seqs = [m.sequence_number for m in orderer.op_log.read(0)]
+    assert seqs == list(range(1, len(seqs) + 1))
+
+
+def test_file_queue_offsets_survive_restart(tmp_path):
+    root = str(tmp_path)
+    q = FileOrderingQueue(root, 2)
+    q.produce(0, "d", {"x": 1})
+    q.produce(0, "d", {"x": 2})
+    q.commit(0, 0)
+    q2 = FileOrderingQueue(root, 2)
+    assert q2.committed(0) == 0
+    recs = list(q2.read(0, q2.committed(0) + 1))
+    assert len(recs) == 1 and recs[0].payload == {"x": 2}
+    assert q2.produce(0, "d", {"x": 3}) == 2

@@ -1,0 +1,126 @@
+"""Sequence-axis sharding (SURVEY §5.7): a long document's slot slab
+split across devices must produce BIT-IDENTICAL state to the
+single-device executor on the same sequenced streams.
+
+The collective path reuses fused_step through its AxisPrims seam, so
+equality here pins the prefix-sum offsets, the pmin/psum point lookups,
+and the ppermute boundary exchange all at once.
+"""
+import jax
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.mergetree import MergeTreeClient
+from fluidframework_tpu.ops import (
+    apply_window,
+    build_batch,
+    encode_stream,
+    extract_signature,
+    extract_text,
+    fetch,
+    make_table,
+)
+from fluidframework_tpu.parallel import (
+    apply_window_seq_sharded,
+    make_seq_mesh,
+)
+from fluidframework_tpu.testing import FuzzConfig, record_op_stream
+
+
+def _streams(n_docs, base_seed, steps=120):
+    cases = [
+        record_op_stream(FuzzConfig(
+            n_clients=3, n_steps=steps, seed=base_seed + 13 * i,
+            remove_weight=0.3, annotate_weight=0.15,
+        ))
+        for i in range(n_docs)
+    ]
+    return [t for t, _ in cases], [s for _, s in cases]
+
+
+def _run_both(streams, capacity, mesh):
+    encs = [encode_stream(s) for s in streams]
+    batch = build_batch(encs)
+    table = make_table(len(encs), capacity)
+    ref = fetch(apply_window(table, batch))
+    shd = fetch(apply_window_seq_sharded(table, batch, mesh))
+    return encs, ref, shd
+
+
+def _assert_tables_equal(ref, shd):
+    for key in ref:
+        np.testing.assert_array_equal(
+            ref[key], shd[key], err_msg=f"field {key} diverged"
+        )
+
+
+def test_seq_sharded_bit_identical_8way():
+    mesh = make_seq_mesh(jax.devices())  # 1 doc lane x 8 seq shards
+    texts, streams = _streams(2, base_seed=4001)
+    encs, ref, shd = _run_both(streams, capacity=512, mesh=mesh)
+    _assert_tables_equal(ref, shd)
+    for d, text in enumerate(texts):
+        assert extract_text(shd, encs[d], d) == text
+
+
+def test_seq_sharded_2d_mesh_docs_by_seq():
+    """docs x seq 2-D mesh: collectives stay inside each doc lane."""
+    mesh = make_seq_mesh(jax.devices(), doc_shards=2)
+    texts, streams = _streams(4, base_seed=5501, steps=100)
+    encs, ref, shd = _run_both(streams, capacity=256, mesh=mesh)
+    _assert_tables_equal(ref, shd)
+    for d, text in enumerate(texts):
+        assert extract_text(shd, encs[d], d) == text
+
+
+@pytest.mark.parametrize("seed", [77, 177])
+def test_seq_sharded_signature_matches_oracle(seed):
+    mesh = make_seq_mesh(jax.devices())
+    text, stream = record_op_stream(FuzzConfig(
+        n_clients=4, n_steps=160, seed=seed,
+        remove_weight=0.35, annotate_weight=0.2,
+    ))
+    encs, ref, shd = _run_both([stream], capacity=512, mesh=mesh)
+    assert extract_text(shd, encs[0], 0) == text
+    obs = MergeTreeClient("observer")
+    obs.start_collaboration("observer")
+    for msg in stream:
+        obs.apply_msg(msg)
+    from fluidframework_tpu.ops.host_bridge import interned_signature
+
+    assert extract_signature(shd, encs[0], 0) == interned_signature(
+        obs, encs[0]
+    )
+
+
+def test_seq_sharded_overflow_flag_consistent():
+    """Global capacity = sum of shard capacities: a stream that fits in
+    512 total slots must not overflow even though each shard holds only
+    64, and the overflow decision must match the unsharded table."""
+    mesh = make_seq_mesh(jax.devices())
+    _, streams = _streams(1, base_seed=9100, steps=200)
+    encs, ref, shd = _run_both(streams, capacity=512, mesh=mesh)
+    assert not shd["overflow"].any()
+    np.testing.assert_array_equal(ref["overflow"], shd["overflow"])
+
+
+def test_seq_sharded_rejects_indivisible_capacity():
+    mesh = make_seq_mesh(jax.devices())
+    _, streams = _streams(1, base_seed=1)
+    encs = [encode_stream(s) for s in streams]
+    batch = build_batch(encs)
+    table = make_table(1, 500)
+    with pytest.raises(ValueError, match="not divisible"):
+        apply_window_seq_sharded(table, batch, mesh)
+
+
+def test_seq_sharded_rejects_single_slot_shards():
+    """Shard width 1 would let the two-slot restructure shift cross
+    more than one boundary (data loss) — must refuse loudly."""
+    mesh = make_seq_mesh(jax.devices())
+    _, streams = _streams(1, base_seed=1)
+    encs = [encode_stream(s) for s in streams]
+    batch = build_batch(encs)
+    table = make_table(1, 8)  # 1 slot per shard on the 8-way mesh
+    with pytest.raises(ValueError, match="shard width"):
+        apply_window_seq_sharded(table, batch, mesh)

@@ -400,3 +400,32 @@ def test_summary_preserves_repair_for_old_revives():
     assert fresh.signature() == a.signature()
     vals = [n["value"] for n in fresh._em.forest().fields["root"]]
     assert 1 in vals
+
+
+def test_repair_capture_out_of_range_mod_nested_del():
+    """Regression: a mod addressing a position past the end of its
+    field (the apply walk mods a dummy node there) whose nested fields
+    contain dels must still consume repair-counter slots in the
+    capture pre-pass, or subsequent dels in OTHER fields get repair
+    keys shifted relative to invert's numbering — and the invert then
+    revives the wrong nodes (or 'repair-missing') into wrong fields."""
+    f = Forest({
+        "a": [node("x", value=1)],
+        "b": [node("y", value=2)],
+    })
+    changes = {
+        # mod at pos 1: field 'a' has only 1 node, so the walk mods a
+        # dummy; its nested del consumes repair idx 0
+        "a": [cs.skip(1), cs.mod(fields={"k": [cs.dele(1)]})],
+        # this del must get repair idx 1, matching invert
+        "b": [cs.dele(1)],
+    }
+    fa = applied(f, (changes, "r1"))
+    assert fa.fields["b"] == []
+    back = applied(fa, (invert(changes, "r1"), "r2"))
+    # field b's node must come back as itself, not repair-missing
+    assert back.fields["b"] == [node("y", value=2)]
+    # and nothing from field b may leak into the nested field
+    for nd in back.fields["a"]:
+        for sub in nd.get("fields", {}).get("k", []):
+            assert sub.get("value") != 2
