@@ -2,6 +2,13 @@
 
 Reference analogue: packages/drivers/*.
 """
+from .caching_driver import (
+    CachingDocumentService,
+    CachingMultiplexFactory,
+    FileSnapshotCache,
+    MultiplexedSocketClient,
+    SnapshotCache,
+)
 from .definitions import DeltaStreamConnection, DocumentService
 from .driver_utils import (
     PrefetchingDocumentService,
@@ -18,8 +25,13 @@ from .socket_driver import (
 )
 
 __all__ = [
+    "CachingDocumentService",
+    "CachingMultiplexFactory",
     "DeltaStreamConnection",
     "DocumentService",
+    "FileSnapshotCache",
+    "MultiplexedSocketClient",
+    "SnapshotCache",
     "PrefetchingDocumentService",
     "RetriableError",
     "RetryDocumentService",

@@ -43,12 +43,38 @@ from ..service.ingress import document_message_to_json, pack_frame
 _LEN = struct.Struct(">I")
 
 
+def build_connect_frame(document_id: str, client_id: str, mode: str,
+                        tenant_id=None, token=None) -> dict:
+    """The connect_document handshake frame — ONE definition so the
+    single-socket and multiplexed drivers cannot diverge on auth/mode
+    fields."""
+    frame = {
+        "type": "connect_document",
+        "document_id": document_id,
+        "client_id": client_id,
+        "mode": mode,
+    }
+    if token is not None:
+        frame["tenant_id"] = tenant_id
+        frame["token"] = token
+    return frame
+
+
 class SocketDocumentService:
     """IDocumentService over the wire; create via the factory."""
 
     def __init__(self, host: str, port: int, document_id: str,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 tenant_id: Optional[str] = None,
+                 token: Optional[str] = None,
+                 mode: str = "write"):
         self.document_id = document_id
+        # riddler-analogue auth (service/tenancy.py): sent with the
+        # connect_document handshake when the server gates on tokens
+        self.tenant_id = tenant_id
+        self.token = token
+        self.mode = mode
+        self.auth_error: Optional[str] = None
         self.lock = threading.RLock()
         self._timeout = timeout
         self._sock = socket.create_connection((host, port),
@@ -114,7 +140,7 @@ class SocketDocumentService:
                         event.set()
                     continue
                 if frame.get("type") == "connected":
-                    self._connected.set()
+                    self._on_connected(frame)
                 else:
                     self._inbox.put(frame)
         finally:
@@ -136,8 +162,22 @@ class SocketDocumentService:
             with self.lock:
                 self._deliver(frame)
 
+    def _on_connected(self, frame: dict) -> None:
+        """Handshake-ack hook (the multiplexing subclass routes by
+        document_id)."""
+        self._connected.set()
+
+    def _on_connect_error(self, frame: dict) -> None:
+        # auth/handshake rejection: record the reason and release the
+        # waiter so it can raise immediately with the cause
+        self.auth_error = frame.get("message", "rejected")
+        self._connected.set()
+
     def _deliver(self, frame: dict) -> None:
         kind = frame.get("type")
+        if kind == "connect_document_error":
+            self._on_connect_error(frame)
+            return
         if kind == "error":
             # a submit the server could neither sequence nor nack
             # (e.g. undecodable op contents): losing it silently would
@@ -192,26 +232,42 @@ class SocketDocumentService:
     ) -> "SocketDeltaConnection":
         self._on_message = on_message
         self._on_nack = on_nack
-        self._send({
-            "type": "connect_document",
-            "document_id": self.document_id,
-            "client_id": client_id,
-        })
+        # a retried handshake must not see the previous attempt's
+        # rejection or completion state
+        self.auth_error = None
+        self._connected.clear()
+        self._send(build_connect_frame(
+            self.document_id, client_id, self.mode,
+            self.tenant_id, self.token))
         if not self._connected.wait(self._timeout):
             raise TimeoutError("connect_document handshake timed out")
+        if self.auth_error is not None:
+            raise PermissionError(
+                f"connect_document rejected: {self.auth_error}")
         return SocketDeltaConnection(self, client_id)
 
     def read_ops(self, from_seq: int,
                  to_seq: Optional[int] = None) -> list[SequencedMessage]:
+        return self._doc_read_ops(self.document_id, from_seq, to_seq)
+
+    def get_latest_summary(self) -> Optional[tuple[int, dict]]:
+        return self._doc_latest_summary(self.document_id)
+
+    # single definitions of the request planes, parameterized by
+    # document so the multiplexed facades reuse them verbatim
+    def _doc_read_ops(self, document_id: str, from_seq: int,
+                      to_seq: Optional[int] = None
+                      ) -> list[SequencedMessage]:
         frame = self._request({
-            "type": "read_ops", "document_id": self.document_id,
+            "type": "read_ops", "document_id": document_id,
             "from_seq": from_seq, "to_seq": to_seq,
         })
         return [message_from_json(m) for m in frame["msgs"]]
 
-    def get_latest_summary(self) -> Optional[tuple[int, dict]]:
+    def _doc_latest_summary(self, document_id: str
+                            ) -> Optional[tuple[int, dict]]:
         frame = self._request({
-            "type": "fetch_summary", "document_id": self.document_id,
+            "type": "fetch_summary", "document_id": document_id,
         })
         if frame.get("sequence_number") is None:
             return None

@@ -11,8 +11,14 @@ from .consensus import (
 from .counter import SharedCounter
 from .ink import Ink
 from .intervals import IntervalCollection, SequenceInterval
+from .legacy_tree import LegacySharedTree
 from .map import MapKernel, SharedDirectory, SharedMap
 from .matrix import SharedMatrix
+from .ot import SharedJson, SharedOT
+from .property_dds import (
+    PropertySchemaRegistry,
+    SharedPropertyTree,
+)
 from .quorum_dds import SharedQuorum
 from .sharedstring import SharedString
 from .summaryblock import SharedSummaryBlock
@@ -31,6 +37,9 @@ def default_registry() -> ChannelRegistry:
         simple_factory(SharedCell),
         simple_factory(SharedCounter),
         simple_factory(SharedTree),
+        simple_factory(LegacySharedTree),
+        simple_factory(SharedJson),
+        simple_factory(SharedPropertyTree),
         simple_factory(ConsensusRegisterCollection),
         simple_factory(ConsensusOrderedCollection),
         simple_factory(TaskManager),
@@ -50,10 +59,15 @@ __all__ = [
     "SharedCell",
     "SharedCounter",
     "SharedDirectory",
+    "SharedJson",
     "SharedMap",
     "SharedMatrix",
+    "PropertySchemaRegistry",
+    "SharedOT",
+    "SharedPropertyTree",
     "SharedQuorum",
     "SharedString",
+    "LegacySharedTree",
     "SharedSummaryBlock",
     "SharedTree",
     "TaskManager",

@@ -23,6 +23,7 @@ from .partitioning import (
     partition_for,
 )
 from .sequencer import DocumentSequencer, TicketResult
+from .tenancy import AuthError, Tenant, TenantManager, sign_token
 from .tpu_sidecar import TpuMergeSidecar
 
 __all__ = [
@@ -38,6 +39,10 @@ __all__ = [
     "OrderingQueue",
     "Partition",
     "PartitionedOrderingService",
+    "AuthError",
+    "Tenant",
+    "TenantManager",
+    "sign_token",
     "partition_for",
     "OpLog",
     "ScribeLambda",

@@ -47,6 +47,7 @@ from ..protocol.messages import (
     DocumentMessage,
     MessageType,
     Nack,
+    NackErrorType,
     SequencedMessage,
 )
 from ..protocol.serialization import (
@@ -126,6 +127,9 @@ class _ClientSession:
         self.writer = writer
         self.outbound: asyncio.Queue[Optional[bytes]] = asyncio.Queue()
         self.connections: dict[str, DeltaConnection] = {}
+        # documents this session has passed the token gate for (a
+        # disconnect keeps the authorization; the token was validated)
+        self.authorized: set[str] = set()
 
     def send(self, data: dict) -> None:
         self.outbound.put_nowait(pack_frame(data))
@@ -153,10 +157,15 @@ class AlfredServer:
     pipeline — deli/scriptorium/broadcaster/scribe equivalents)."""
 
     def __init__(self, local: Optional[LocalServer] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 tenants: Optional[Any] = None):
         self.local = local or LocalServer()
         self.host = host
         self.port = port
+        # optional riddler-analogue TenantManager (service/tenancy.py):
+        # when set, connect_document must carry tenant_id + a valid
+        # signed claims token (alfred's verifyToken gate)
+        self.tenants = tenants
         self._server: Optional[asyncio.base_events.Server] = None
 
     async def start(self) -> None:
@@ -205,12 +214,45 @@ class AlfredServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
+    def _check_read_access(self, session: _ClientSession,
+                           doc: str) -> None:
+        """When tokens are enforced, the storage planes (read_ops /
+        fetch_summary) require a prior successful connect_document for
+        the document — otherwise an unauthenticated socket could read
+        any document's full op log with no credentials."""
+        if self.tenants is not None and doc not in session.authorized:
+            raise PermissionError(
+                f"not authorized for document {doc!r}: "
+                "connect_document with a valid token first"
+            )
+
     def _dispatch(self, session: _ClientSession, frame: dict) -> None:
         kind = frame.get("type")
         doc = frame.get("document_id")
         if kind == "connect_document":
             client_id = frame["client_id"]
             details = frame.get("details") or {}
+            # "read" connections subscribe without joining the quorum
+            # (alfred gates the required scope by requested mode)
+            mode = frame.get("mode", "write")
+            if self.tenants is not None:
+                from .tenancy import SCOPE_READ, SCOPE_WRITE, AuthError
+
+                try:
+                    self.tenants.validate_token(
+                        frame.get("token", ""),
+                        frame.get("tenant_id", ""),
+                        doc,
+                        required_scope=SCOPE_WRITE if mode == "write"
+                        else SCOPE_READ,
+                    )
+                except AuthError as e:
+                    session.send({
+                        "type": "connect_document_error",
+                        "document_id": doc,
+                        "message": str(e),
+                    })
+                    return
             # a retried connect supersedes the old connection: leaving
             # it joined would pin the document's msn at its refSeq and
             # double-deliver every op to this session
@@ -229,16 +271,31 @@ class AlfredServer:
                 }),
                 detail=ClientDetail(client_id, **details)
                 if details else None,
+                read_only=(mode == "read"),
             )
             session.connections[doc] = conn
+            session.authorized.add(doc)
             session.send({
                 "type": "connected", "document_id": doc,
                 "client_id": client_id,
             })
         elif kind == "submitOp":
             conn = session.connections[doc]
-            conn.submit(document_message_from_json(frame["op"]))
+            try:
+                conn.submit(document_message_from_json(frame["op"]))
+            except PermissionError as e:
+                # read-mode connection: reject as a NACK so the driver's
+                # on_nack fires (parity with the in-proc path, which
+                # raises to the caller directly)
+                session.send({
+                    "type": "nack", "document_id": doc,
+                    "operation": frame["op"],
+                    "sequence_number": 0,
+                    "error_type": int(NackErrorType.INVALID_SCOPE),
+                    "message": str(e),
+                })
         elif kind == "read_ops":
+            self._check_read_access(session, doc)
             msgs = self.local.read_ops(
                 doc, frame["from_seq"], frame.get("to_seq")
             )
@@ -247,6 +304,7 @@ class AlfredServer:
                 "msgs": [message_to_json(m) for m in msgs],
             })
         elif kind == "fetch_summary":
+            self._check_read_access(session, doc)
             latest = self.local.latest_summary(doc)
             payload: dict[str, Any] = {
                 "type": "summary", "rid": frame.get("rid"),

@@ -26,17 +26,22 @@ class DeltaConnection:
     analogue: driver-base/src/documentDeltaConnection.ts:41)."""
 
     def __init__(self, server: "LocalServer", orderer: LocalOrderer,
-                 client_id: str, connection_id: str):
+                 client_id: str, connection_id: str,
+                 read_only: bool = False):
         self._server = server
         self._orderer = orderer
         self.client_id = client_id
         self.connection_id = connection_id
+        self.read_only = read_only
         self.open = True
         self.on_message: Optional[Callable[[SequencedMessage], None]] = None
         self.on_nack: Optional[Callable[[Nack], None]] = None
 
     def submit(self, op: DocumentMessage) -> None:
         assert self.open, "submit on closed connection"
+        if self.read_only:
+            raise PermissionError(
+                "submit on a read-mode connection (doc:read scope)")
         nack = self._orderer.submit(self.client_id, op)
         if nack is not None and self.on_nack is not None:
             self.on_nack(nack)
@@ -46,7 +51,8 @@ class DeltaConnection:
             return
         self.open = False
         self._orderer.broadcaster.unsubscribe(self.connection_id)
-        self._orderer.disconnect(self.client_id)
+        if not self.read_only:
+            self._orderer.disconnect(self.client_id)
 
 
 class LocalServer:
@@ -82,10 +88,15 @@ class LocalServer:
                 on_message: Callable[[SequencedMessage], None],
                 on_nack: Optional[Callable[[Nack], None]] = None,
                 detail: Optional[ClientDetail] = None,
+                read_only: bool = False,
                 ) -> DeltaConnection:
+        """``read_only`` = the reference's "read" connection mode:
+        broadcast subscription only — no quorum join (the client's
+        refSeq never pins the msn) and submit is rejected."""
         orderer = self.get_orderer(document_id)
         connection_id = f"conn-{next(self._conn_counter)}"
-        conn = DeltaConnection(self, orderer, client_id, connection_id)
+        conn = DeltaConnection(self, orderer, client_id, connection_id,
+                               read_only=read_only)
         conn.on_message = on_message
         conn.on_nack = on_nack
         # subscribe BEFORE the join op so the client sees its own join
@@ -93,7 +104,8 @@ class LocalServer:
             connection_id, lambda msg: conn.on_message and
             conn.on_message(msg)
         )
-        orderer.connect(detail or ClientDetail(client_id))
+        if not read_only:
+            orderer.connect(detail or ClientDetail(client_id))
         return conn
 
     # ------------------------------------------------------------------

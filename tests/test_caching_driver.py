@@ -1,0 +1,184 @@
+"""odsp-class driver: snapshot caching (fresh hit / refresh / stale
+offline fallback, on-disk persistence) and socket multiplexing (many
+documents, one TCP connection).
+"""
+import asyncio
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.drivers.caching_driver import (
+    CachingDocumentService,
+    CachingMultiplexFactory,
+    FileSnapshotCache,
+    MultiplexedSocketClient,
+    SnapshotCache,
+)
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.service.ingress import AlfredServer
+
+
+@pytest.fixture()
+def server():
+    srv = AlfredServer()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    async def _run():
+        await srv.start()
+        started.set()
+        try:
+            await srv.serve_forever()
+        except asyncio.CancelledError:
+            pass
+
+    holder = {}
+
+    def runner():
+        task = loop.create_task(_run())
+        holder["task"] = task
+        try:
+            loop.run_until_complete(task)
+        except Exception:
+            pass
+
+    thread = threading.Thread(target=runner, daemon=True)
+    thread.start()
+    assert started.wait(10)
+    yield srv
+    loop.call_soon_threadsafe(holder["task"].cancel)
+    thread.join(timeout=10)
+    loop.call_soon_threadsafe(loop.stop)
+
+
+# ---- snapshot cache ---------------------------------------------------
+
+class _FakeService:
+    document_id = "doc"
+
+    def __init__(self):
+        self.calls = 0
+        self.fail = False
+        self.summary = (7, {"tree": "v1"})
+
+    def get_latest_summary(self):
+        if self.fail:
+            raise ConnectionError("offline")
+        self.calls += 1
+        return self.summary
+
+
+def test_cache_fresh_hit_skips_network():
+    inner = _FakeService()
+    svc = CachingDocumentService(inner, SnapshotCache(), max_age_s=60)
+    assert svc.get_latest_summary() == (7, {"tree": "v1"})
+    assert svc.last_load_source == "network"
+    assert svc.get_latest_summary() == (7, {"tree": "v1"})
+    assert svc.last_load_source == "cache"
+    assert inner.calls == 1
+
+
+def test_cache_age_policy_refreshes():
+    inner = _FakeService()
+    svc = CachingDocumentService(inner, SnapshotCache(), max_age_s=0.0)
+    svc.get_latest_summary()
+    inner.summary = (9, {"tree": "v2"})
+    time.sleep(0.01)
+    assert svc.get_latest_summary() == (9, {"tree": "v2"})
+    assert svc.last_load_source == "network"
+    assert inner.calls == 2
+
+
+def test_stale_cache_serves_offline_load():
+    inner = _FakeService()
+    svc = CachingDocumentService(inner, SnapshotCache(), max_age_s=0.0)
+    svc.get_latest_summary()
+    inner.fail = True
+    time.sleep(0.01)
+    assert svc.get_latest_summary() == (7, {"tree": "v1"})
+    assert svc.last_load_source == "stale-cache"
+
+
+def test_offline_without_cache_raises():
+    inner = _FakeService()
+    inner.fail = True
+    svc = CachingDocumentService(inner, SnapshotCache())
+    with pytest.raises(ConnectionError):
+        svc.get_latest_summary()
+
+
+def test_file_cache_survives_restart(tmp_path):
+    c1 = FileSnapshotCache(str(tmp_path))
+    c1.put("doc", 5, {"blob": [1, 2, 3]})
+    c2 = FileSnapshotCache(str(tmp_path))
+    entry = c2.get("doc")
+    assert entry["sequence_number"] == 5
+    assert entry["summary"] == {"blob": [1, 2, 3]}
+
+
+# ---- multiplexing -----------------------------------------------------
+
+def test_two_documents_one_socket(server):
+    factory = CachingMultiplexFactory("127.0.0.1", server.port)
+    sa = factory.create_document_service("doc-x")
+    sb = factory.create_document_service("doc-y")
+    # both facades share one physical client
+    assert factory._client is not None
+    client = factory._client
+
+    with sa.lock:
+        a = Container.load(sa, client_id="alice")
+        ta = (a.runtime.create_datastore("d")
+              .create_channel("sharedstring", "t"))
+        a.flush()
+        ta.insert_text(0, "doc-x-text")
+        a.flush()
+    with sb.lock:
+        b = Container.load(sb, client_id="bob")
+        tb = (b.runtime.create_datastore("d")
+              .create_channel("sharedstring", "t"))
+        b.flush()
+        tb.insert_text(0, "doc-y-text")
+        b.flush()
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with client.lock:
+            if ta.get_text() == "doc-x-text" \
+                    and tb.get_text() == "doc-y-text":
+                break
+        time.sleep(0.05)
+    with client.lock:
+        # no cross-document bleed through the shared socket
+        assert ta.get_text() == "doc-x-text"
+        assert tb.get_text() == "doc-y-text"
+    a.close()
+    b.close()
+    factory.close()
+
+
+def test_multiplexed_second_client_catches_up(server):
+    factory = CachingMultiplexFactory("127.0.0.1", server.port,
+                                      max_age_s=0.0)
+    s1 = factory.create_document_service("doc-m")
+    with s1.lock:
+        c1 = Container.load(s1, client_id="alice")
+        t1 = (c1.runtime.create_datastore("d")
+              .create_channel("sharedstring", "t"))
+        c1.flush()
+        t1.insert_text(0, "shared state")
+        c1.flush()
+
+    # a second process-worth of client over ITS OWN factory/socket
+    factory2 = CachingMultiplexFactory("127.0.0.1", server.port,
+                                       max_age_s=0.0)
+    s2 = factory2.create_document_service("doc-m")
+    with s2.lock:
+        c2 = Container.load(s2, client_id="bob")
+        t2 = c2.runtime.get_datastore("d").get_channel("t")
+        assert t2.get_text() == "shared state"
+    c1.close()
+    c2.close()
+    factory.close()
+    factory2.close()

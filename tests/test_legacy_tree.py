@@ -1,0 +1,272 @@
+"""Legacy (anchor-based) SharedTree: atomic edits, anchor re-resolution
+under concurrency, edit drop semantics, constraints, undo from repair
+data, summarize/load.
+
+Reference behavior: experimental/dds/tree/src/{TransactionInternal.ts,
+ChangeTypes.ts, HistoryEditFactory.ts}.
+"""
+import pytest
+
+from fluidframework_tpu.models.legacy_tree import (
+    APPLIED,
+    INVALID,
+    MALFORMED,
+    build,
+    constraint,
+    delete_,
+    detach,
+    insert,
+    insert_tree,
+    move,
+    place_after,
+    place_at_end,
+    place_at_start,
+    place_before,
+    range_all,
+    range_of,
+    set_value,
+)
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+
+def make_session(n=2):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    s = ContainerSession(ids)
+    for c in ids:
+        s.runtime(c).create_datastore("ds").create_channel(
+            "legacysharedtree", "tree")
+    trees = [
+        s.runtime(c).get_datastore("ds").get_channel("tree")
+        for c in ids
+    ]
+    return s, trees
+
+
+def leaf(ident, definition="item", payload=None):
+    return {"definition": definition, "identifier": ident,
+            "payload": payload}
+
+
+def kids_of(tree, parent="root", label="items"):
+    return tree.view.trait(parent, label)
+
+
+def test_build_insert_roundtrip():
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("n1", payload=1), leaf("n2", payload=2)],
+                        place_at_start("root", "items")))
+    s.process_all()
+    assert kids_of(a) == ["n1", "n2"]
+    assert kids_of(b) == ["n1", "n2"]
+    assert a.signature() == b.signature()
+    assert a.edit_log[-1]["status"] == APPLIED
+
+
+def test_concurrent_sibling_anchored_inserts():
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("base")],
+                        place_at_start("root", "items")))
+    s.process_all()
+    # both insert after the same sibling concurrently; both anchors
+    # re-resolve -> both land, sequenced order decides adjacency
+    a.apply(insert_tree([leaf("a1")], place_after("base")))
+    b.apply(insert_tree([leaf("b1")], place_after("base")))
+    s.process_all()
+    assert a.signature() == b.signature()
+    assert set(kids_of(a)) == {"base", "a1", "b1"}
+    assert kids_of(a)[0] == "base"
+
+
+def test_edit_on_concurrently_deleted_sibling_drops():
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("x"), leaf("y")],
+                        place_at_start("root", "items")))
+    s.process_all()
+    # A deletes x; B concurrently anchors an insert after x
+    a.apply(delete_(range_of(place_before("x"), place_after("x"))))
+    b.apply(insert_tree([leaf("z")], place_after("x")))
+    s.process_all()
+    assert a.signature() == b.signature()
+    # B's edit dropped: its anchor no longer resolves
+    assert kids_of(a) == ["y"]
+    statuses = [e["status"] for e in a.edit_log]
+    assert statuses[-1] == INVALID
+
+
+def test_atomicity_partial_failure_rolls_back():
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("x", payload=0)],
+                        place_at_start("root", "items")))
+    s.process_all()
+    # one edit: a valid set_value AND an invalid insert -> whole edit
+    # drops, payload untouched
+    a.apply(set_value("x", 99), insert(7, place_after("ghost")))
+    s.process_all()
+    assert a.view.nodes["x"]["payload"] == 0
+    assert b.view.nodes["x"]["payload"] == 0
+    assert a.edit_log[-1]["status"] == MALFORMED
+
+
+def test_constraint_guards_edit():
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("x"), leaf("y")],
+                        place_at_start("root", "items")))
+    s.process_all()
+    # A's edit requires the trait to still have exactly 2 items
+    a.apply([constraint(range_all("root", "items"), length=2),
+             set_value("x", "guarded")])
+    # B concurrently deletes y -> A's constraint must fail on every
+    # replica IF B sequences first; here A sequenced first so it lands
+    s.process_all()
+    assert a.view.nodes["x"]["payload"] == "guarded"
+    b.apply(delete_(range_of(place_before("y"), place_after("y"))))
+    a.apply([constraint(range_all("root", "items"), length=2),
+             set_value("x", "second")])
+    s.flush("B")  # B's delete sequences before A's guarded edit
+    s.process_all()
+    # constraint (length==2) fails after the delete
+    assert a.view.nodes["x"]["payload"] == "guarded"
+    assert a.edit_log[-1]["status"] == INVALID
+    assert a.signature() == b.signature()
+
+
+def test_move_between_traits():
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("box", "container")],
+                        place_at_start("root", "items")))
+    a.apply(insert_tree([leaf("ball")], place_at_start("root", "loose")))
+    s.process_all()
+    a.apply(move(range_of(place_before("ball"), place_after("ball")),
+                 place_at_start("box", "contents")))
+    s.process_all()
+    assert kids_of(a, "box", "contents") == ["ball"]
+    assert kids_of(a, "root", "loose") == []
+    assert a.signature() == b.signature()
+
+
+def test_set_value_lww_by_sequencing():
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("x")], place_at_start("root", "items")))
+    s.process_all()
+    a.apply(set_value("x", "from-a"))
+    b.apply(set_value("x", "from-b"))
+    s.process_all()
+    assert a.signature() == b.signature()
+    # later-sequenced write wins
+    assert a.view.nodes["x"]["payload"] == "from-b"
+
+
+def test_undo_delete_restores_subtree():
+    s, (a, b) = make_session()
+    a.apply(insert_tree(
+        [leaf("p", "parent"), leaf("q")],
+        place_at_start("root", "items")))
+    eid = a.apply(
+        insert_tree([leaf("kid", payload=5)],
+                    place_at_start("p", "children")))
+    s.process_all()
+    del_id = a.apply(delete_(range_of(place_before("p"),
+                                      place_after("p"))))
+    s.process_all()
+    assert "p" not in a.view.nodes
+    a.revert(del_id)
+    s.process_all()
+    assert a.signature() == b.signature()
+    assert kids_of(a) == ["p", "q"]
+    assert kids_of(a, "p", "children") == ["kid"]
+    assert a.view.nodes["kid"]["payload"] == 5
+
+
+def test_undo_insert_detaches_it():
+    s, (a, b) = make_session()
+    eid = a.apply(insert_tree([leaf("x")],
+                              place_at_start("root", "items")))
+    s.process_all()
+    a.revert(eid)
+    s.process_all()
+    assert kids_of(a) == []
+    assert a.signature() == b.signature()
+
+
+def test_pending_local_view_is_optimistic():
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("x")], place_at_start("root", "items")))
+    # before sequencing: A sees it, B does not
+    assert kids_of(a) == ["x"]
+    assert kids_of(b) == []
+    s.process_all()
+    assert kids_of(b) == ["x"]
+
+
+def test_summarize_load_roundtrip():
+    s, (a, b) = make_session()
+    a.apply(insert_tree(
+        [leaf("p", "parent", payload="v")],
+        place_at_start("root", "items")))
+    a.apply(insert_tree([leaf("c", payload=3)],
+                        place_at_start("p", "sub")))
+    s.process_all()
+    summary = a.summarize_core()
+    from fluidframework_tpu.models.legacy_tree import LegacySharedTree
+
+    fresh = LegacySharedTree("tree2")
+    fresh.load_core(summary)
+    assert fresh.signature() == a.signature()
+    assert fresh.view.nodes["c"]["payload"] == 3
+
+
+def test_duplicate_node_id_is_malformed():
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("x")], place_at_start("root", "items")))
+    s.process_all()
+    a.apply(insert_tree([leaf("x")], place_at_end("root", "items")))
+    s.process_all()
+    assert a.edit_log[-1]["status"] == MALFORMED
+    assert kids_of(a) == ["x"]
+    assert a.signature() == b.signature()
+
+
+def test_revert_move_moves_back():
+    """Regression: reverting a move must move the subtree BACK, not
+    delete it (the insert half's inverse used to be a plain delete)."""
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("n1", payload="keep")],
+                        place_at_start("root", "items")))
+    s.process_all()
+    mid = a.apply(move(range_of(place_before("n1"), place_after("n1")),
+                       place_at_end("root", "archive")))
+    s.process_all()
+    assert kids_of(a, "root", "archive") == ["n1"]
+    a.revert(mid)
+    s.process_all()
+    assert a.signature() == b.signature()
+    assert kids_of(a) == ["n1"]
+    assert kids_of(a, "root", "archive") == []
+    assert a.view.nodes["n1"]["payload"] == "keep"
+
+
+def test_revert_ids_do_not_collide_across_clients():
+    """Regression: repair data is keyed by global seq; two clients'
+    edit #N must not collide (revert used to invert the wrong edit)."""
+    s, (a, b) = make_session()
+    # both clients' FIRST edit (local edit_id 0 on each side)
+    a_id = a.apply(insert_tree([leaf("from-a", payload="A")],
+                               place_at_start("root", "items")))
+    b_id = b.apply(insert_tree([leaf("from-b", payload="B")],
+                               place_at_end("root", "items")))
+    s.process_all()
+    assert a_id == b_id == 0  # the collision-prone ids
+    # A reverts ITS edit: only from-a disappears
+    a.revert(a_id)
+    s.process_all()
+    assert a.signature() == b.signature()
+    assert "from-a" not in a.view.nodes
+    assert "from-b" in a.view.nodes
+    # history undo by sequence number still reaches any edit
+    seq_of_b = next(e["seq"] for e in a.edit_log
+                    if e["status"] == APPLIED
+                    and "from-b" in str(e["changes"]))
+    a.revert_seq(seq_of_b)
+    s.process_all()
+    assert "from-b" not in a.view.nodes
+    assert a.signature() == b.signature()

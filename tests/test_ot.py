@@ -1,0 +1,191 @@
+"""OT bridge (SharedOT / SharedJson): transform-based convergence under
+concurrency — list index shifts, deleted-subtree drops, numeric-add
+commutation, collab-window pruning.
+
+Reference behavior: experimental/dds/ot/ot/src/ot.ts processCore.
+"""
+import pytest
+
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+
+def make_session(n=2):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    s = ContainerSession(ids)
+    for c in ids:
+        s.runtime(c).create_datastore("ds").create_channel(
+            "sharedjson", "j")
+    chans = [
+        s.runtime(c).get_datastore("ds").get_channel("j") for c in ids
+    ]
+    return s, chans
+
+
+def converged(s, chans):
+    s.process_all()
+    sig = chans[0].signature()
+    for c in chans[1:]:
+        assert c.signature() == sig, (sig, c.signature())
+    return sig
+
+
+def test_basic_set_get():
+    s, (a, b) = make_session()
+    a.set(["title"], "hello")
+    sig = converged(s, [a, b])
+    assert sig == {"title": "hello"}
+    assert b.get(["title"]) == "hello"
+
+
+def test_concurrent_sets_different_keys_merge():
+    s, (a, b) = make_session()
+    a.set(["x"], 1)
+    b.set(["y"], 2)
+    sig = converged(s, [a, b])
+    assert sig == {"x": 1, "y": 2}
+
+
+def test_concurrent_set_same_key_lww():
+    s, (a, b) = make_session()
+    a.set(["k"], "from-a")
+    b.set(["k"], "from-b")
+    sig = converged(s, [a, b])
+    # later-sequenced wins (B flushes after A in session order)
+    assert sig == {"k": "from-b"}
+
+
+def test_concurrent_list_inserts_shift():
+    s, (a, b) = make_session()
+    a.set(["items"], [])
+    s.process_all()
+    a.list_insert(["items"], 0, "a0")
+    b.list_insert(["items"], 0, "b0")
+    sig = converged(s, [a, b])
+    # earlier-sequenced keeps the left slot
+    assert sig == {"items": ["a0", "b0"]}
+
+
+def test_concurrent_delete_and_edit_inside():
+    s, (a, b) = make_session()
+    a.set(["items"], [{"v": 1}, {"v": 2}])
+    s.process_all()
+    a.list_delete(["items"], 0)
+    b.set(["items", 0, "v"], 99)  # edits the element A deletes
+    sig = converged(s, [a, b])
+    # B's edit inside the deleted element drops
+    assert sig == {"items": [{"v": 2}]}
+
+
+def test_concurrent_deletes_same_element():
+    s, (a, b) = make_session()
+    a.set(["items"], ["x", "y"])
+    s.process_all()
+    a.list_delete(["items"], 0)
+    b.list_delete(["items"], 0)
+    sig = converged(s, [a, b])
+    # one element deleted once, not twice
+    assert sig == {"items": ["y"]}
+
+
+def test_delete_shifts_later_indices():
+    s, (a, b) = make_session()
+    a.set(["items"], ["x", "y", "z"])
+    s.process_all()
+    a.list_delete(["items"], 0)
+    b.set(["items", 2], "Z")  # addresses 'z' pre-delete
+    sig = converged(s, [a, b])
+    assert sig == {"items": ["y", "Z"]}
+
+
+def test_numeric_add_commutes():
+    s, (a, b) = make_session()
+    a.set(["count"], 0)
+    s.process_all()
+    a.add(["count"], 5)
+    b.add(["count"], 7)
+    sig = converged(s, [a, b])
+    assert sig == {"count": 12}
+
+
+def test_object_delete_drops_nested_edit():
+    s, (a, b) = make_session()
+    a.set(["cfg"], {"depth": 1})
+    s.process_all()
+    a.remove(["cfg"])
+    b.set(["cfg", "depth"], 2)
+    sig = converged(s, [a, b])
+    assert sig == {}
+
+
+def test_delete_then_concurrent_recreate_survives():
+    s, (a, b) = make_session()
+    a.set(["cfg"], {"old": True})
+    s.process_all()
+    a.remove(["cfg"])
+    b.set(["cfg"], {"new": True})  # full re-set of the key survives
+    sig = converged(s, [a, b])
+    assert sig == {"cfg": {"new": True}}
+
+
+def test_sequenced_window_prunes_below_msn():
+    s, (a, b) = make_session()
+    for i in range(10):
+        # both clients submit so both refSeqs (and hence the msn)
+        # advance — an idle client correctly pins the window open
+        a.set([f"ka{i}"], i)
+        s.process_all()
+        b.set([f"kb{i}"], i)
+        s.process_all()
+    assert len(a._sequenced) <= 4
+    assert len(b._sequenced) <= 4
+
+
+def test_summarize_load_roundtrip():
+    s, (a, b) = make_session()
+    a.set(["x"], {"nested": [1, 2, 3]})
+    s.process_all()
+    from fluidframework_tpu.models.ot import SharedJson
+
+    fresh = SharedJson("j2")
+    fresh.load_core(a.summarize_core())
+    assert fresh.signature() == a.signature()
+    assert fresh.get(["x", "nested", 1]) == 2
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_ot_convergence_fuzz(seed):
+    import random
+
+    rng = random.Random(seed * 101 + 5)
+    s, chans = make_session(3)
+    chans[0].set(["lst"], [])
+    chans[0].set(["num"], 0)
+    s.process_all()
+    for round_ in range(12):
+        for c in chans:
+            action = rng.random()
+            lst = c.get(["lst"], [])
+            if action < 0.4:
+                c.list_insert(["lst"], rng.randrange(len(lst) + 1),
+                              f"{round_}")
+            elif action < 0.6 and lst:
+                c.list_delete(["lst"], rng.randrange(len(lst)))
+            elif action < 0.8:
+                c.add(["num"], rng.randrange(10))
+            else:
+                c.set([f"k{rng.randrange(4)}"], round_)
+        if rng.random() < 0.6:
+            s.process_all()
+    converged(s, chans)
+
+
+def test_na_over_concurrent_replace_drops():
+    """Regression: a numeric add racing a same-path replace with a
+    non-number must drop (it used to TypeError on every replica)."""
+    s, (a, b) = make_session()
+    a.set(["k"], 0)
+    s.process_all()
+    a.set(["k"], "now-a-string")
+    b.add(["k"], 1)
+    sig = converged(s, [a, b])
+    assert sig == {"k": "now-a-string"}

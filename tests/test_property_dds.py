@@ -1,0 +1,198 @@
+"""PropertyDDS: typed schemas, squash-on-commit changesets, per-path
+merge (LWW modify, remove-wins), summarize/load.
+
+Reference behavior: experimental/PropertyDDS/packages/{property-dds,
+property-changeset,property-properties}.
+"""
+import pytest
+
+from fluidframework_tpu.models.property_dds import (
+    PropertySchemaRegistry,
+    SharedPropertyTree,
+    empty_changeset,
+    is_empty,
+    squash,
+)
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+POINT = {
+    "typeid": "test:point-1.0.0",
+    "properties": [
+        {"id": "x", "typeid": "Float64"},
+        {"id": "y", "typeid": "Float64"},
+        {"id": "label", "typeid": "String"},
+    ],
+}
+
+
+def make_session(n=2):
+    ids = [chr(ord("A") + i) for i in range(n)]
+    s = ContainerSession(ids)
+    trees = []
+    for c in ids:
+        s.runtime(c).create_datastore("ds").create_channel(
+            "sharedpropertytree", "pt")
+        t = s.runtime(c).get_datastore("ds").get_channel("pt")
+        t.schemas.register(POINT)
+        trees.append(t)
+    s.process_all()  # drain the channel-attach ops
+    return s, trees
+
+
+def converged(s, trees):
+    s.process_all()
+    sig = trees[0].signature()
+    for t in trees[1:]:
+        assert t.signature() == sig
+    return sig
+
+
+# ---- schemas ---------------------------------------------------------
+
+def test_schema_instantiate_defaults():
+    reg = PropertySchemaRegistry()
+    reg.register(POINT)
+    node = reg.instantiate("test:point-1.0.0")
+    assert node["children"]["x"] == {"typeid": "Float64", "value": 0.0}
+    assert node["children"]["label"]["value"] == ""
+
+
+def test_schema_rejects_unknown_typeid():
+    reg = PropertySchemaRegistry()
+    with pytest.raises(ValueError, match="unregistered"):
+        reg.instantiate("test:nope-1.0.0")
+
+
+def test_primitive_type_enforcement():
+    s, (a, b) = make_session()
+    a.insert_property("p", "test:point-1.0.0")
+    a.commit()
+    s.process_all()
+    with pytest.raises(TypeError):
+        a.set_value("p.x", "not-a-number")
+    with pytest.raises(KeyError):
+        a.set_value("p.ghost", 1)
+
+
+# ---- commit model ----------------------------------------------------
+
+def test_edits_buffer_until_commit():
+    s, (a, b) = make_session()
+    a.insert_property("n", "Int32", 5)
+    assert a.dirty
+    s.process_all()
+    assert b.get_value("n") is None  # nothing shipped yet
+    a.commit()
+    assert not a.dirty
+    s.process_all()
+    assert b.get_value("n") == 5
+
+
+def test_squash_insert_modify_remove():
+    cs = empty_changeset()
+    cs = squash(cs, {"insert": {"a": {"typeid": "Int32", "value": 1}},
+                     "modify": {}, "remove": []})
+    cs = squash(cs, {"insert": {}, "modify": {"a": 9}, "remove": []})
+    # insert∘modify folds into the insert
+    assert cs["insert"]["a"]["value"] == 9
+    assert cs["modify"] == {}
+    cs = squash(cs, {"insert": {}, "modify": {}, "remove": ["a"]})
+    # insert∘remove annihilates
+    assert is_empty(cs)
+
+
+def test_squash_modify_modify_last_wins():
+    cs = squash(
+        {"insert": {}, "modify": {"p.x": 1.0}, "remove": []},
+        {"insert": {}, "modify": {"p.x": 2.0}, "remove": []})
+    assert cs["modify"] == {"p.x": 2.0}
+
+
+def test_commit_ships_one_op_per_commit():
+    s, (a, b) = make_session()
+    a.insert_property("p", "test:point-1.0.0")
+    a.set_value("p.x", 1.5)
+    a.set_value("p.x", 2.5)
+    a.set_value("p.label", "pt")
+    a.commit()
+    s.flush("A")
+    assert s.pending_count == 1  # squashed into a single changeset op
+    s.process_all()
+    assert b.get_value("p.x") == 2.5
+    assert b.get_value("p.label") == "pt"
+
+
+# ---- merge semantics -------------------------------------------------
+
+def test_concurrent_modify_lww():
+    s, (a, b) = make_session()
+    a.insert_property("p", "test:point-1.0.0")
+    a.commit()
+    s.process_all()
+    a.set_value("p.x", 1.0)
+    a.commit()
+    b.set_value("p.x", 2.0)
+    b.commit()
+    converged(s, [a, b])
+    assert a.get_value("p.x") == 2.0  # later-sequenced commit wins
+
+
+def test_remove_wins_over_nested_modify():
+    s, (a, b) = make_session()
+    a.insert_property("p", "test:point-1.0.0")
+    a.commit()
+    s.process_all()
+    a.remove_property("p")
+    a.commit()
+    b.set_value("p.x", 9.0)
+    b.commit()
+    sig = converged(s, [a, b])
+    assert sig["children"] == {}
+
+
+def test_concurrent_inserts_different_paths():
+    s, (a, b) = make_session()
+    a.insert_property("pa", "test:point-1.0.0")
+    a.commit()
+    b.insert_property("pb", "Int32", 7)
+    b.commit()
+    converged(s, [a, b])
+    assert a.get_value("pb") == 7
+    assert b.resolve("pa") is not None
+
+
+def test_pending_commit_is_optimistic_locally():
+    s, (a, b) = make_session()
+    a.insert_property("n", "Int32", 3)
+    a.commit()
+    assert a.get_value("n") == 3   # pending, optimistic
+    assert b.get_value("n") is None
+    s.process_all()
+    assert b.get_value("n") == 3
+
+
+def test_summarize_load_roundtrip():
+    s, (a, b) = make_session()
+    a.insert_property("p", "test:point-1.0.0",
+                      {"x": 4.0, "label": "origin"})
+    a.commit()
+    s.process_all()
+    fresh = SharedPropertyTree("pt2")
+    fresh.load_core(a.summarize_core())
+    assert fresh.signature() == a.signature()
+    assert fresh.get_value("p.x") == 4.0
+
+
+def test_remove_under_pending_insert_squashes_into_it():
+    """Regression: removing a child of a not-yet-committed insert must
+    edit the insert spec (a global remove would no-op because removes
+    apply before inserts)."""
+    s, (a, b) = make_session()
+    a.insert_property("p", "test:point-1.0.0")
+    a.remove_property("p.label")
+    a.commit()
+    s.process_all()
+    assert a.resolve("p.label") is None
+    assert b.resolve("p.label") is None
+    assert b.resolve("p.x") is not None
+    assert a.signature() == b.signature()

@@ -1,0 +1,313 @@
+"""services-core conformance: the concrete service classes satisfy the
+interface layer (structural Protocols), and the riddler-analogue
+token path gates the networked ingress.
+"""
+import time
+
+import pytest
+
+from fluidframework_tpu.service.core_interfaces import (
+    IConsumer,
+    IContentStore,
+    IOpLog,
+    IOrderer,
+    IOrdererManager,
+    IProducer,
+    ITelemetrySink,
+    ITenantManager,
+)
+from fluidframework_tpu.service.lambdas import OpLog
+from fluidframework_tpu.service.local_orderer import LocalOrderer
+from fluidframework_tpu.service.local_server import LocalServer
+from fluidframework_tpu.service.partitioning import (
+    FileOrderingQueue,
+    InMemoryOrderingQueue,
+)
+from fluidframework_tpu.service.storage import ContentStore
+from fluidframework_tpu.service.telemetry import Lumberjack
+from fluidframework_tpu.service.tenancy import (
+    SCOPE_READ,
+    SCOPE_WRITE,
+    AuthError,
+    TenantManager,
+    sign_token,
+)
+
+
+def test_concrete_classes_conform():
+    assert isinstance(LocalOrderer("d"), IOrderer)
+    assert isinstance(LocalServer(), IOrdererManager)
+    assert isinstance(OpLog(), IOpLog)
+    q = InMemoryOrderingQueue(1)
+    assert isinstance(q, IProducer)
+    assert isinstance(q, IConsumer)
+    assert isinstance(ContentStore(), IContentStore)
+    assert isinstance(TenantManager(), ITenantManager)
+    assert isinstance(Lumberjack(), ITelemetrySink)
+
+
+def test_file_queue_conforms(tmp_path):
+    q = FileOrderingQueue(str(tmp_path), 1)
+    assert isinstance(q, IProducer)
+    assert isinstance(q, IConsumer)
+
+
+# ---- tenancy / tokens -------------------------------------------------
+
+def test_token_roundtrip():
+    tm = TenantManager()
+    t = tm.create_tenant("acme", "Acme Inc")
+    tok = sign_token(t.key, "acme", "doc1", "alice")
+    claims = tm.validate_token(tok, "acme", "doc1", SCOPE_WRITE)
+    assert claims["user"]["id"] == "alice"
+
+
+def test_token_rejections():
+    tm = TenantManager()
+    t = tm.create_tenant("acme")
+    tok = sign_token(t.key, "acme", "doc1", "alice")
+    with pytest.raises(AuthError, match="document mismatch"):
+        tm.validate_token(tok, "acme", "other-doc")
+    with pytest.raises(AuthError, match="unknown or disabled"):
+        tm.validate_token(tok, "ghost", "doc1")
+    with pytest.raises(AuthError, match="bad signature"):
+        tm.validate_token(tok[:-4] + "AAAA", "acme", "doc1")
+    expired = sign_token(t.key, "acme", "doc1", "alice",
+                         lifetime_s=-5)
+    with pytest.raises(AuthError, match="expired"):
+        tm.validate_token(expired, "acme", "doc1")
+    ro = sign_token(t.key, "acme", "doc1", "alice",
+                    scopes=[SCOPE_READ])
+    with pytest.raises(AuthError, match="missing scope"):
+        tm.validate_token(ro, "acme", "doc1", SCOPE_WRITE)
+
+
+def test_disabled_tenant_rejected():
+    tm = TenantManager()
+    t = tm.create_tenant("acme")
+    tok = sign_token(t.key, "acme", "doc1", "alice")
+    tm.disable_tenant("acme")
+    with pytest.raises(AuthError):
+        tm.validate_token(tok, "acme", "doc1")
+
+
+def test_key_refresh_invalidates_old_tokens():
+    tm = TenantManager()
+    t = tm.create_tenant("acme")
+    tok = sign_token(t.key, "acme", "doc1", "alice")
+    tm.refresh_key("acme")
+    with pytest.raises(AuthError, match="bad signature"):
+        tm.validate_token(tok, "acme", "doc1")
+
+
+# ---- authenticated ingress -------------------------------------------
+
+def test_alfred_rejects_bad_token_and_accepts_good():
+    import asyncio
+
+    from fluidframework_tpu.service.ingress import (
+        AlfredServer,
+        pack_frame,
+        read_frame,
+    )
+
+    tm = TenantManager()
+    tenant = tm.create_tenant("acme")
+
+    async def scenario():
+        server = AlfredServer(tenants=tm)
+        await server.start()
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", server.port)
+
+        # bad token -> connect_document_error
+        writer.write(pack_frame({
+            "type": "connect_document", "document_id": "d",
+            "client_id": "alice", "tenant_id": "acme",
+            "token": "bogus.token",
+        }))
+        await writer.drain()
+        resp = await read_frame(reader)
+        assert resp["type"] == "connect_document_error"
+        assert "malformed token" in resp["message"]
+
+        # good token -> connected
+        tok = sign_token(tenant.key, "acme", "d", "alice")
+        writer.write(pack_frame({
+            "type": "connect_document", "document_id": "d",
+            "client_id": "alice", "tenant_id": "acme", "token": tok,
+        }))
+        await writer.drain()
+        while True:
+            resp = await read_frame(reader)
+            if resp["type"] in ("connected", "connect_document_error"):
+                break
+        assert resp["type"] == "connected"
+        writer.close()
+        await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_read_mode_connection_cannot_write_and_does_not_pin_msn():
+    """Read-scoped connections subscribe without joining the quorum."""
+    from fluidframework_tpu.service.local_server import LocalServer
+
+    server = LocalServer()
+    seen = []
+    ro = server.connect("d", "reader", on_message=seen.append,
+                        read_only=True)
+    # reader is not in the quorum
+    assert "reader" not in server.get_orderer("d").sequencer.clients
+    # a writer's ops still reach the reader
+    rw = server.connect("d", "writer", on_message=lambda m: None)
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+
+    rw.submit(DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents={"x": 1}))
+    assert any(getattr(m, "type", None) == MessageType.OPERATION
+               for m in seen)
+    with pytest.raises(PermissionError, match="read-mode"):
+        ro.submit(DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={}))
+
+
+def test_storage_planes_require_auth():
+    """Regression: read_ops/fetch_summary must not bypass the token
+    gate — an unauthenticated socket could read any document."""
+    import asyncio
+
+    from fluidframework_tpu.service.ingress import (
+        AlfredServer,
+        pack_frame,
+        read_frame,
+    )
+
+    tm = TenantManager()
+    tenant = tm.create_tenant("acme")
+
+    async def scenario():
+        server = AlfredServer(tenants=tm)
+        await server.start()
+        # seed the document through an authed connection
+        r1, w1 = await asyncio.open_connection("127.0.0.1", server.port)
+        tok = sign_token(tenant.key, "acme", "d", "alice")
+        w1.write(pack_frame({
+            "type": "connect_document", "document_id": "d",
+            "client_id": "alice", "tenant_id": "acme", "token": tok,
+        }))
+        await w1.drain()
+
+        # unauthenticated socket tries to read the op log
+        r2, w2 = await asyncio.open_connection("127.0.0.1", server.port)
+        w2.write(pack_frame({
+            "type": "read_ops", "rid": 1, "document_id": "d",
+            "from_seq": 0,
+        }))
+        await w2.drain()
+        resp = await read_frame(r2)
+        assert resp["type"] == "error"
+        assert "not authorized" in resp["message"]
+        w2.write(pack_frame({
+            "type": "fetch_summary", "rid": 2, "document_id": "d",
+        }))
+        await w2.drain()
+        resp = await read_frame(r2)
+        assert resp["type"] == "error"
+        w1.close()
+        w2.close()
+        await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_read_mode_submit_nacked_over_socket():
+    """Regression: a submit on a read-mode SOCKET connection must fire
+    on_nack (not vanish into a stderr log)."""
+    import asyncio
+
+    from fluidframework_tpu.service.ingress import (
+        AlfredServer,
+        pack_frame,
+        read_frame,
+    )
+    from fluidframework_tpu.protocol.messages import NackErrorType
+
+    async def scenario():
+        server = AlfredServer()
+        await server.start()
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", server.port)
+        writer.write(pack_frame({
+            "type": "connect_document", "document_id": "d",
+            "client_id": "viewer", "mode": "read",
+        }))
+        await writer.drain()
+        resp = await read_frame(reader)
+        assert resp["type"] == "connected"
+        writer.write(pack_frame({
+            "type": "submitOp", "document_id": "d",
+            "op": {"client_sequence_number": 1,
+                   "reference_sequence_number": 0,
+                   "type": 2, "contents": None, "metadata": None,
+                   "traces": []},
+        }))
+        await writer.drain()
+        while True:
+            resp = await read_frame(reader)
+            if resp["type"] == "nack":
+                break
+        assert resp["error_type"] == int(NackErrorType.INVALID_SCOPE)
+        assert "read-mode" in resp["message"]
+        writer.close()
+        await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_multiplexed_token_refresh_not_sticky():
+    """Regression: a rejected facade must accept a new token on retry
+    (cached facade used to keep the old token + sticky auth_error)."""
+    import asyncio
+    import threading
+
+    from fluidframework_tpu.drivers.caching_driver import (
+        MultiplexedSocketClient,
+    )
+    from fluidframework_tpu.service.ingress import AlfredServer
+
+    tm = TenantManager()
+    tenant = tm.create_tenant("acme")
+    server = AlfredServer(tenants=tm)
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(server.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    try:
+        client = MultiplexedSocketClient("127.0.0.1", server.port,
+                                         timeout=5)
+        bad = client.document_service("d", tenant_id="acme",
+                                      token="junk.tok")
+        with pytest.raises(PermissionError):
+            bad.connect_to_delta_stream("alice", lambda m: None)
+        good_tok = sign_token(tenant.key, "acme", "d", "alice")
+        good = client.document_service("d", tenant_id="acme",
+                                       token=good_tok)
+        conn = good.connect_to_delta_stream("alice", lambda m: None)
+        assert conn.open
+        client.close()
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
