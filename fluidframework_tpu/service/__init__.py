@@ -6,6 +6,7 @@ Reference analogue: server/routerlicious/packages/*.
 from .ingress import AlfredServer
 from .lambdas import (
     BroadcasterLambda,
+    CopierLambda,
     OpLog,
     ScribeLambda,
     ScriptoriumLambda,
@@ -29,6 +30,7 @@ from .tpu_sidecar import TpuMergeSidecar
 __all__ = [
     "AlfredServer",
     "BroadcasterLambda",
+    "CopierLambda",
     "CheckpointManager",
     "DeltaConnection",
     "DocumentSequencer",

@@ -87,6 +87,35 @@ class ScriptoriumLambda:
         self.op_log.append(msg)
 
 
+class CopierLambda:
+    """copier — verbatim RAW-op capture BEFORE sequencing
+    (lambdas/src/copier: writes the pre-deli input stream so the exact
+    bytes a client submitted survive for audit/replay even when deli
+    nacks or dedups them)."""
+
+    def __init__(self, sink: Optional[list] = None) -> None:
+        self.raw: list = sink if sink is not None else []
+
+    def handler(self, document_id: str, client_id: str,
+                payload: Any) -> None:
+        import copy as _copy
+
+        self.raw.append({
+            "document_id": document_id,
+            "client_id": client_id,
+            "payload": _copy.deepcopy(payload),
+        })
+
+    def read(self, document_id: Optional[str] = None) -> list:
+        """Deep copies: the capture is the audit record — a consumer
+        mutating a returned dict must not corrupt it."""
+        import copy as _copy
+
+        return [_copy.deepcopy(r) for r in self.raw
+                if document_id is None
+                or r["document_id"] == document_id]
+
+
 class BroadcasterLambda:
     """broadcaster/lambda.ts:49 — per-document fan-out."""
 

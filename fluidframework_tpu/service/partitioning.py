@@ -245,7 +245,8 @@ class Partition:
 
     def __init__(self, queue: OrderingQueue, index: int,
                  orderer_factory: Callable[[str], LocalOrderer],
-                 on_nack: Optional[Callable[[str, Nack], None]] = None):
+                 on_nack: Optional[Callable[[str, Nack], None]] = None,
+                 on_record: Optional[Callable] = None):
         self.queue = queue
         self.index = index
         self.checkpoints = CheckpointManager(queue, index)
@@ -253,6 +254,8 @@ class Partition:
         self._orderer_factory = orderer_factory
         self._next_offset = queue.committed(index) + 1
         self._on_nack = on_nack
+        # copier hook: observes every raw record pre-sequencing
+        self._on_record = on_record
         self.paused = False
 
     def document(self, document_id: str) -> DocumentPartition:
@@ -277,6 +280,11 @@ class Partition:
             records = itertools.islice(records, max_records)
         for rec in records:
             self.checkpoints.starting(rec.offset)
+            if self._on_record is not None:
+                payload = rec.payload
+                client_id = payload.get("client_id") or \
+                    (payload.get("detail") or {}).get("client_id", "")
+                self._on_record(rec.document_id, client_id, payload)
             nack = self.document(rec.document_id).process(rec.payload)
             if nack is not None and self._on_nack is not None:
                 self._on_nack(rec.document_id, nack)
@@ -300,7 +308,8 @@ class PartitionedOrderingService:
 
     def __init__(self, n_partitions: int = 4,
                  queue: Optional[OrderingQueue] = None,
-                 durable_dir: Optional[str] = None):
+                 durable_dir: Optional[str] = None,
+                 copier: Optional[Any] = None):
         self.n_partitions = n_partitions
         self.durable_dir = durable_dir
         if queue is None:
@@ -311,9 +320,11 @@ class PartitionedOrderingService:
             else:
                 queue = InMemoryOrderingQueue(n_partitions)
         self.queue = queue
+        self.copier = copier  # CopierLambda: raw pre-deli capture
         self.nacks: list[tuple[str, Nack]] = []
         self.partitions = [
-            Partition(queue, p, self._make_orderer, self._record_nack)
+            Partition(queue, p, self._make_orderer, self._record_nack,
+                      on_record=copier.handler if copier else None)
             for p in range(n_partitions)
         ]
 
@@ -384,5 +395,6 @@ class PartitionedOrderingService:
                 "consumer (unpause the existing partition instead)"
             )
         self.partitions[index] = Partition(
-            self.queue, index, self._make_orderer, self._record_nack
+            self.queue, index, self._make_orderer, self._record_nack,
+            on_record=self.copier.handler if self.copier else None,
         )

@@ -1,14 +1,25 @@
-"""Test infrastructure: mock sequencer sessions, seeded fuzzing.
+"""Test infrastructure: mock sequencer sessions, seeded fuzzing,
+stored-format compat matrix.
 
 Reference analogue: packages/runtime/test-runtime-utils,
-packages/test/stochastic-test-utils.
+packages/test/stochastic-test-utils, packages/test/test-version-utils.
 """
+from .compat import (
+    CompatConfig,
+    compat_matrix,
+    downgrade_channel_summary,
+    import_as_fresh_document,
+)
 from .fuzz import FuzzConfig, record_op_stream, run_convergence_fuzz
 from .mocks import MockCollabSession
 
 __all__ = [
+    "CompatConfig",
     "FuzzConfig",
     "MockCollabSession",
+    "compat_matrix",
+    "downgrade_channel_summary",
+    "import_as_fresh_document",
     "record_op_stream",
     "run_convergence_fuzz",
 ]

@@ -1,0 +1,105 @@
+"""Compat matrix (describeCompat analogue): every scenario runs for
+each writer configuration — current format and the oldest supported
+(legacy) format — asserting load, collaboration, and forward
+re-summarize. Guards the persisted-format axis the way
+packages/test/test-version-utils guards version pairings.
+"""
+import pytest
+
+from fluidframework_tpu.models import SharedString
+from fluidframework_tpu.testing.compat import (
+    CompatConfig,
+    compat_matrix,
+    downgrade_sharedstring_summary,
+    import_as_fresh_document,
+)
+from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+MATRIX = list(compat_matrix())
+
+
+def _build_document():
+    """A session whose summary exercises text, markers, removes,
+    props, and attribution."""
+    s = ContainerSession(["A", "B"])
+    for c in ("A", "B"):
+        s.runtime(c).create_datastore("ds").create_channel(
+            "sharedstring", "t")
+    s.process_all()
+    a = s.runtime("A").get_datastore("ds").get_channel("t")
+    b = s.runtime("B").get_datastore("ds").get_channel("t")
+    a.insert_text(0, "hello brave world")
+    s.process_all()
+    b.remove_text(6, 12)  # drop "brave "
+    s.process_all()
+    a.annotate_range(0, 5, {"bold": 1})
+    s.process_all()
+    return s, a, b
+
+
+@pytest.mark.parametrize("config", MATRIX, ids=lambda c: c.name)
+def test_summary_loads_across_formats(config: CompatConfig):
+    s, a, b = _build_document()
+    summary = config.channel_summary("sharedstring",
+                                     a.summarize_core())
+    if config.summary_format == "legacy":
+        assert "segments" in summary and "chunks" not in summary
+    fresh = SharedString("t2")
+    fresh.load_core(summary)
+    assert fresh.get_text() == a.get_text() == "hello world"
+    # forward re-summarize: ALWAYS the current format, whatever loaded
+    again = fresh.summarize_core()
+    assert again.get("format") == 2 and "chunks" in again
+
+
+@pytest.mark.parametrize("config", MATRIX, ids=lambda c: c.name)
+def test_legacy_loaded_replica_collaborates(config: CompatConfig):
+    """A replica booted from an old-format summary must converge with
+    current-format replicas in live collaboration (the new-runtime +
+    old-snapshot pairing)."""
+    s, a, b = _build_document()
+    summary = config.channel_summary("sharedstring",
+                                     a.summarize_core())
+    # booting a NEW document from stored content: rebase into the new
+    # document's sequence space (same-document loads keep the original
+    # seq space via the op log — tests/test_local_server.py)
+    imported = import_as_fresh_document(summary)
+
+    s2 = ContainerSession(["X", "Y"])
+    for c in ("X", "Y"):
+        ds = s2.runtime(c).create_datastore("ds")
+        chan = ds.create_channel("sharedstring", "t")
+        chan.client.mergetree.segments.clear()
+        chan.load_core(imported)
+    s2.process_all()
+    x = s2.runtime("X").get_datastore("ds").get_channel("t")
+    y = s2.runtime("Y").get_datastore("ds").get_channel("t")
+    x.insert_text(0, ">> ")
+    y.insert_text(len(y.get_text()), " <<")
+    s2.process_all()
+    assert x.get_text() == y.get_text()
+    assert x.get_text() == ">> hello world <<"
+
+
+def test_downgrade_preserves_content_exactly():
+    s, a, b = _build_document()
+    current = a.summarize_core()
+    legacy = downgrade_sharedstring_summary(current)
+    flat_current = [e for chunk in current["chunks"] for e in chunk]
+    assert legacy["segments"] == flat_current
+    assert legacy["minSeq"] == current["minSeq"]
+
+
+def test_downgraded_summary_shape_matches_golden_fixture():
+    """The committed golden fixture (written by the round-3 format-1
+    era writer) and downgrade_sharedstring_summary must agree on the
+    legacy shape: the downgrade of a current summary must load through
+    the same code path the fixture does."""
+    s, a, b = _build_document()
+    legacy = downgrade_sharedstring_summary(a.summarize_core())
+    # the legacy shape: flat segments list, no format/chunks keys
+    assert set(legacy) >= {"segments", "minSeq", "currentSeq"}
+    assert "chunks" not in legacy and "format" not in legacy
+    fresh = SharedString("from-legacy")
+    fresh.load_core(legacy)
+    assert fresh.get_text() == a.get_text()

@@ -153,3 +153,25 @@ def test_file_queue_offsets_survive_restart(tmp_path):
     recs = list(q2.read(0, q2.committed(0) + 1))
     assert len(recs) == 1 and recs[0].payload == {"x": 2}
     assert q2.produce(0, "d", {"x": 3}) == 2
+
+
+def test_copier_captures_raw_pre_deli_stream():
+    """copier: the verbatim raw stream survives even when deli nacks
+    or dedups records."""
+    from fluidframework_tpu.service.lambdas import CopierLambda
+
+    copier = CopierLambda()
+    svc = PartitionedOrderingService(n_partitions=2, copier=copier)
+    svc.produce_join("doc", ClientDetail(client_id="a"))
+    svc.produce_op("doc", "a", op(1))
+    svc.produce_op("doc", "a", op(1))      # duplicate: deli drops it
+    svc.produce_op("doc", "ghost", op(1))  # nacked: not in quorum
+    svc.pump()
+    raw = copier.read("doc")
+    # all four records captured verbatim, including the dropped ones
+    assert len(raw) == 4
+    kinds = [r["payload"]["kind"] for r in raw]
+    assert kinds == ["join", "op", "op", "op"]
+    # the sequenced log saw only join + one op
+    seqs = [m.sequence_number for m in svc.orderer("doc").op_log.read(0)]
+    assert len(seqs) == 2
