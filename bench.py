@@ -41,7 +41,7 @@ import tempfile
 import time
 
 STAGES = ("probe", "config1", "config2", "config3", "config4",
-          "config5")
+          "config5", "config6")
 
 
 # ======================================================================
@@ -686,6 +686,88 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
     }
 
 
+def stage_config6(scale: str, reps: int, cooldown: float) -> dict:
+    """Capacity-edge cliffs (VERDICT r2 weak #5): the costs the steady
+    state hides, measured — (a) per-apply latency while the slab fits,
+    (b) the REGROW event (2x slab + full stream re-replay), (c) host
+    EVICTION at the ladder top, (d) the evicted document's host-path
+    read. Sized so the ladder + eviction are guaranteed to fire."""
+    import numpy as np
+
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.service import LocalServer, TpuMergeSidecar
+
+    docs, rounds, max_cap, chunk = {
+        "full": (8, 220, 128, "abcdefghij"),
+        "cpu": (4, 170, 128, "abcdefgh"),
+        "smoke": (2, 80, 64, "abcdef"),
+    }[scale]
+
+    server = LocalServer()
+    sidecar = TpuMergeSidecar(max_docs=docs, capacity=32,
+                              max_capacity=max_cap)
+    factory = LocalDocumentServiceFactory(server)
+    sessions = []
+    for d in range(docs):
+        doc = f"doc-{d}"
+        sidecar.subscribe(server, doc, "ds", "ch")
+        c = Container.load(factory.create_document_service(doc),
+                           client_id=f"w{d}")
+        s = c.runtime.create_datastore("ds").create_channel(
+            "sharedstring", "ch")
+        sessions.append((c, s))
+
+    steady_ms, grow_events, evict_events = [], [], []
+    for i in range(rounds):
+        for c, s in sessions:
+            s.insert_text(0, chunk)
+            c.flush()
+            if i % 3 == 2 and s.get_length() > 6:
+                s.remove_text(2, 5)
+                c.flush()
+        grows0, evicts0 = sidecar.grow_count, sidecar.evict_count
+        t0 = time.perf_counter()
+        sidecar.apply()
+        np.asarray(sidecar._table.count)  # force device completion
+        ms = (time.perf_counter() - t0) * 1e3
+        if sidecar.evict_count > evicts0:
+            evict_events.append(ms)
+        elif sidecar.grow_count > grows0:
+            grow_events.append(ms)
+        else:
+            steady_ms.append(ms)
+
+    # parity after the full ladder + eviction
+    for d, (c, s) in enumerate(sessions):
+        assert sidecar.text(f"doc-{d}", "ds", "ch") == s.get_text(), (
+            f"config6 divergence doc {d}"
+        )
+    # host-path read latency for an evicted doc
+    t0 = time.perf_counter()
+    _ = sidecar.text("doc-0", "ds", "ch")
+    read_ms = (time.perf_counter() - t0) * 1e3
+
+    steady = sorted(steady_ms)
+    med = steady[len(steady) // 2] if steady else None
+    return {
+        "docs": docs,
+        "rounds": rounds,
+        "steady_apply_ms_median": round(med, 2) if med else None,
+        "steady_apply_ms_p95": round(
+            steady[int(len(steady) * 0.95)], 2) if steady else None,
+        "grow_count": sidecar.grow_count,
+        "grow_event_ms": [round(g, 1) for g in grow_events],
+        "grow_vs_steady_ratio": round(
+            max(grow_events) / med, 1) if grow_events and med else None,
+        "evict_count": sidecar.evict_count,
+        "evict_event_ms": [round(e, 1) for e in evict_events],
+        "host_docs_after": sidecar.host_mode_docs(),
+        "evicted_read_ms": round(read_ms, 2),
+        "parity": f"text-verified x{docs}",
+    }
+
+
 STAGE_FNS = {
     "probe": stage_probe,
     "config1": stage_config1,
@@ -693,6 +775,7 @@ STAGE_FNS = {
     "config3": stage_config3,
     "config4": stage_config4,
     "config5": stage_config5,
+    "config6": stage_config6,
 }
 
 

@@ -26,8 +26,12 @@ def main() -> None:
     parser.add_argument("--data-dir", default=None,
                         help="durable storage root (op logs, "
                              "summaries, checkpoints)")
+    parser.add_argument("--partitions", type=int, default=0,
+                        help="route through N queue partitions (the "
+                             "scale-out pipeline shape); 0 = inline "
+                             "orderer")
     args = parser.parse_args()
-    run_server(args.host, args.port, args.data_dir)
+    run_server(args.host, args.port, args.data_dir, args.partitions)
 
 
 if __name__ == "__main__":

@@ -325,13 +325,22 @@ class AlfredServer:
 
 
 def run_server(host: str = "127.0.0.1", port: int = 7070,
-               data_dir: Optional[str] = None) -> None:
+               data_dir: Optional[str] = None,
+               partitions: int = 0) -> None:
     """Blocking entry point (the tinylicious analogue; see
     service/__main__.py). ``data_dir`` makes every document durable:
-    op log, summaries and deli checkpoints survive restarts."""
-    server = AlfredServer(
-        LocalServer(durable_dir=data_dir), host=host, port=port
-    )
+    op log, summaries and deli checkpoints survive restarts.
+    ``partitions`` > 0 routes everything through the partitioned
+    queue pipeline (the kafka-deployment shape) instead of the inline
+    orderer."""
+    if partitions > 0:
+        from .partitioning import PartitionedServer
+
+        local = PartitionedServer(
+            n_partitions=partitions, durable_dir=data_dir)
+    else:
+        local = LocalServer(durable_dir=data_dir)
+    server = AlfredServer(local, host=host, port=port)
 
     async def main():
         await server.start()

@@ -280,14 +280,14 @@ class Partition:
             records = itertools.islice(records, max_records)
         for rec in records:
             self.checkpoints.starting(rec.offset)
+            payload = rec.payload
+            client_id = payload.get("client_id") or \
+                (payload.get("detail") or {}).get("client_id", "")
             if self._on_record is not None:
-                payload = rec.payload
-                client_id = payload.get("client_id") or \
-                    (payload.get("detail") or {}).get("client_id", "")
                 self._on_record(rec.document_id, client_id, payload)
             nack = self.document(rec.document_id).process(rec.payload)
             if nack is not None and self._on_nack is not None:
-                self._on_nack(rec.document_id, nack)
+                self._on_nack(rec.document_id, client_id, nack)
             self.checkpoints.completed(rec.offset)
             self._next_offset = rec.offset + 1
             n += 1
@@ -321,15 +321,16 @@ class PartitionedOrderingService:
                 queue = InMemoryOrderingQueue(n_partitions)
         self.queue = queue
         self.copier = copier  # CopierLambda: raw pre-deli capture
-        self.nacks: list[tuple[str, Nack]] = []
+        self.nacks: list[tuple[str, str, Nack]] = []
         self.partitions = [
             Partition(queue, p, self._make_orderer, self._record_nack,
                       on_record=copier.handler if copier else None)
             for p in range(n_partitions)
         ]
 
-    def _record_nack(self, document_id: str, nack: Nack) -> None:
-        self.nacks.append((document_id, nack))
+    def _record_nack(self, document_id: str, client_id: str,
+                     nack: Nack) -> None:
+        self.nacks.append((document_id, client_id, nack))
 
     def _make_orderer(self, document_id: str) -> LocalOrderer:
         storage = None
@@ -398,3 +399,131 @@ class PartitionedOrderingService:
             self.queue, index, self._make_orderer, self._record_nack,
             on_record=self.copier.handler if self.copier else None,
         )
+
+
+# ----------------------------------------------------------------------
+# LocalServer-surface adapter
+
+
+class _PartitionedDeltaConnection:
+    """DeltaConnection surface whose submit PRODUCES into the queue
+    (alfred -> Kafka -> deli), then pumps the owning partition."""
+
+    def __init__(self, server: "PartitionedServer", document_id: str,
+                 client_id: str, connection_id: str,
+                 read_only: bool = False):
+        self._server = server
+        self.document_id = document_id
+        self.client_id = client_id
+        self.connection_id = connection_id
+        self.read_only = read_only
+        self.open = True
+        self.on_message = None
+        self.on_nack = None
+
+    def submit(self, op: DocumentMessage) -> None:
+        assert self.open, "submit on closed connection"
+        if self.read_only:
+            raise PermissionError(
+                "submit on a read-mode connection (doc:read scope)")
+        self._server.svc.produce_op(
+            self.document_id, self.client_id, op)
+        self._server.pump_document(self.document_id)
+
+    def disconnect(self) -> None:
+        if not self.open:
+            return
+        self.open = False
+        orderer = self._server.svc.orderer(self.document_id)
+        orderer.broadcaster.unsubscribe(self.connection_id)
+        # only remove OUR registration: a reconnect may already have
+        # re-registered the same (doc, client) for a newer connection
+        key = (self.document_id, self.client_id)
+        route = self._server._nack_routes.get(key)
+        if route is not None and route[0] == self.connection_id:
+            self._server._nack_routes.pop(key, None)
+        if not self.read_only:
+            self._server.svc.produce_leave(
+                self.document_id, self.client_id)
+            self._server.pump_document(self.document_id)
+
+
+class PartitionedServer:
+    """The LocalServer surface over the PARTITIONED pipeline: the
+    single-box deployment shape where the front door produces raw
+    records into the broker seam and per-partition consumers sequence
+    them (alfred -> Kafka -> deli -> broadcaster), instead of calling
+    deli inline. Drop-in for AlfredServer's ``local=``; selected by
+    ``python -m fluidframework_tpu.service --partitions N``."""
+
+    def __init__(self, n_partitions: int = 4,
+                 durable_dir: Optional[str] = None,
+                 copier=None):
+        import itertools as _it
+
+        self.svc = PartitionedOrderingService(
+            n_partitions=n_partitions, durable_dir=durable_dir,
+            copier=copier,
+        )
+        self.svc._record_nack = self._route_nack
+        for p in self.svc.partitions:
+            p._on_nack = self._route_nack
+        self._nack_routes: dict[tuple[str, str], Any] = {}
+        self._conn_counter = _it.count()
+
+    # nacks route to the SUBMITTING client's connection only (alfred
+    # emits them on the submitting socket) — the partition hands us
+    # the raw record's client id, so the lookup is exact
+    def _route_nack(self, document_id: str, client_id: str,
+                    nack) -> None:
+        self.svc.nacks.append((document_id, client_id, nack))
+        route = self._nack_routes.get((document_id, client_id))
+        if route is not None:
+            route[1](nack)
+
+    def get_orderer(self, document_id: str) -> LocalOrderer:
+        return self.svc.orderer(document_id)
+
+    def connect(self, document_id: str, client_id: str,
+                on_message, on_nack=None, detail=None,
+                read_only: bool = False) -> _PartitionedDeltaConnection:
+        orderer = self.svc.orderer(document_id)
+        connection_id = f"pconn-{next(self._conn_counter)}"
+        conn = _PartitionedDeltaConnection(
+            self, document_id, client_id, connection_id,
+            read_only=read_only,
+        )
+        conn.on_message = on_message
+        conn.on_nack = on_nack
+        # subscribe BEFORE the join so the client sees its own join
+        orderer.broadcaster.subscribe(
+            connection_id,
+            lambda msg: conn.on_message and conn.on_message(msg),
+        )
+        if on_nack is not None:
+            # keyed by (doc, client) -> (connection_id, handler): the
+            # newest connection wins, and only its own disconnect may
+            # remove the route
+            self._nack_routes[(document_id, client_id)] = (
+                connection_id, on_nack)
+        if not read_only:
+            self.svc.produce_join(
+                document_id, detail or ClientDetail(client_id))
+            self.pump_document(document_id)
+        return conn
+
+    def pump_document(self, document_id: str) -> int:
+        """Drain only the partition that owns ``document_id`` — the
+        connection hot path must not do O(n_partitions) queue reads
+        per op."""
+        return self.svc.partitions[
+            self.svc.partition_of(document_id)
+        ].pump()
+
+    def read_ops(self, document_id: str, from_seq: int,
+                 to_seq: Optional[int] = None):
+        return self.svc.orderer(document_id).op_log.read(
+            from_seq, to_seq)
+
+    def latest_summary(self, document_id: str):
+        return self.svc.orderer(document_id).summary_store.latest()

@@ -175,3 +175,75 @@ def test_copier_captures_raw_pre_deli_stream():
     # the sequenced log saw only join + one op
     seqs = [m.sequence_number for m in svc.orderer("doc").op_log.read(0)]
     assert len(seqs) == 2
+
+
+def test_partitioned_server_behind_ingress():
+    """The partitioned pipeline drop-in behind the networked front
+    door: containers collaborate over TCP while sequencing flows
+    produce -> queue -> partition consumer -> deli."""
+    import asyncio
+    import threading
+    import time as _time
+
+    from fluidframework_tpu.drivers.socket_driver import (
+        SocketDocumentService,
+    )
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.service.ingress import AlfredServer
+    from fluidframework_tpu.service.partitioning import PartitionedServer
+
+    server = AlfredServer(PartitionedServer(n_partitions=2))
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(server.start())
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    try:
+        sa = SocketDocumentService("127.0.0.1", server.port, "pdoc",
+                                   timeout=10)
+        sb = SocketDocumentService("127.0.0.1", server.port, "pdoc",
+                                   timeout=10)
+        with sa.lock:
+            a = Container.load(sa, client_id="alice")
+            ta = (a.runtime.create_datastore("d")
+                  .create_channel("sharedstring", "t"))
+            a.flush()
+            ta.insert_text(0, "partitioned")
+            a.flush()
+        with sb.lock:
+            b = Container.load(sb, client_id="bob")
+            tb = b.runtime.get_datastore("d").get_channel("t")
+            assert tb.get_text() == "partitioned"
+            tb.insert_text(0, "queue-")
+            b.flush()
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            with sa.lock:
+                if ta.get_text() == "queue-partitioned":
+                    break
+            _time.sleep(0.05)
+        with sa.lock, sb.lock:
+            assert ta.get_text() == tb.get_text() == "queue-partitioned"
+        # the sequencing demonstrably went through the queue
+        inner = server.local.svc
+        part = inner.partition_of("pdoc")
+        assert inner.queue.committed(part) >= 2
+        a.close()
+        b.close()
+        sa.close()
+        sb.close()
+    finally:
+        async def _shutdown():
+            await server.stop()
+            loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_shutdown(), loop)
+        t.join(timeout=10)
+        loop.close()
