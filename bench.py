@@ -780,7 +780,7 @@ STAGE_FNS = {
 
 
 def run_stage(name: str, backend: str, scale: str, reps: int,
-              cooldown: float, out_path: str) -> None:
+              cooldown: float, out_path: str | None) -> None:
     _stage_env_setup(backend)
     import jax
 
@@ -794,6 +794,12 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
     # persist the full-scale result BEFORE the fixed-scale companion:
     # if the companion pushes the child past the subprocess timeout,
     # the completed result must not be lost (code-review r3)
+    if out_path is None:
+        # direct `--stage X` invocation without --out: the record goes
+        # to stdout (running a stage for minutes then crashing on
+        # open(None) would discard the measurement)
+        print(json.dumps(result))
+        return
     with open(out_path, "w") as f:
         json.dump(result, f)
     if scale == "full" and name != "probe":

@@ -275,6 +275,14 @@ class SocketDocumentService:
 
     def close(self) -> None:
         self._closed = True
+        # shutdown BEFORE close: close() alone does not unblock a
+        # thread currently inside recv() (it waits out the socket
+        # timeout, deferring our FIN ~10s and stalling server-side
+        # connection teardown); shutdown delivers EOF immediately
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

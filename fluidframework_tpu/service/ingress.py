@@ -324,6 +324,38 @@ class AlfredServer:
             raise ValueError(f"unknown frame type {kind!r}")
 
 
+def _check_durable_layout(data_dir: Optional[str],
+                          partitions: int) -> None:
+    """The inline and partitioned modes use different on-disk layouts,
+    and the partition count is baked into the queue's document->
+    partition routing. Restarting an existing data dir under a
+    different configuration would silently come up empty (or misroute
+    documents to partitions whose logs don't hold their records) —
+    refuse loudly instead."""
+    if data_dir is None:
+        return
+    import json as _json
+    import os as _os
+
+    marker = _os.path.join(data_dir, "layout.json")
+    current = {"mode": "partitioned" if partitions > 0 else "inline",
+               "partitions": partitions}
+    if _os.path.exists(marker):
+        with open(marker) as f:
+            stored = _json.load(f)
+        if stored != current:
+            raise SystemExit(
+                f"data dir {data_dir!r} was created with layout "
+                f"{stored}, refusing to start with {current}: document "
+                "history would be ignored or misrouted. Use the "
+                "original flags or a fresh --data-dir."
+            )
+        return
+    _os.makedirs(data_dir, exist_ok=True)
+    with open(marker, "w") as f:
+        _json.dump(current, f)
+
+
 def run_server(host: str = "127.0.0.1", port: int = 7070,
                data_dir: Optional[str] = None,
                partitions: int = 0) -> None:
@@ -333,6 +365,7 @@ def run_server(host: str = "127.0.0.1", port: int = 7070,
     ``partitions`` > 0 routes everything through the partitioned
     queue pipeline (the kafka-deployment shape) instead of the inline
     orderer."""
+    _check_durable_layout(data_dir, partitions)
     if partitions > 0:
         from .partitioning import PartitionedServer
 

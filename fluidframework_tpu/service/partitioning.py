@@ -245,7 +245,8 @@ class Partition:
 
     def __init__(self, queue: OrderingQueue, index: int,
                  orderer_factory: Callable[[str], LocalOrderer],
-                 on_nack: Optional[Callable[[str, Nack], None]] = None,
+                 on_nack: Optional[
+                     Callable[[str, str, Nack], None]] = None,
                  on_record: Optional[Callable] = None):
         self.queue = queue
         self.index = index
@@ -309,9 +310,15 @@ class PartitionedOrderingService:
     def __init__(self, n_partitions: int = 4,
                  queue: Optional[OrderingQueue] = None,
                  durable_dir: Optional[str] = None,
-                 copier: Optional[Any] = None):
+                 copier: Optional[Any] = None,
+                 on_nack: Optional[
+                     Callable[[str, str, Nack], None]] = None):
         self.n_partitions = n_partitions
         self.durable_dir = durable_dir
+        # external nack hook: every partition (including ones created
+        # by resume_partition) routes through _dispatch_nack, which
+        # records centrally then forwards here
+        self._on_nack_hook = on_nack
         if queue is None:
             if durable_dir is not None:
                 queue = FileOrderingQueue(
@@ -331,6 +338,8 @@ class PartitionedOrderingService:
     def _record_nack(self, document_id: str, client_id: str,
                      nack: Nack) -> None:
         self.nacks.append((document_id, client_id, nack))
+        if self._on_nack_hook is not None:
+            self._on_nack_hook(document_id, client_id, nack)
 
     def _make_orderer(self, document_id: str) -> LocalOrderer:
         storage = None
@@ -463,11 +472,8 @@ class PartitionedServer:
 
         self.svc = PartitionedOrderingService(
             n_partitions=n_partitions, durable_dir=durable_dir,
-            copier=copier,
+            copier=copier, on_nack=self._route_nack,
         )
-        self.svc._record_nack = self._route_nack
-        for p in self.svc.partitions:
-            p._on_nack = self._route_nack
         self._nack_routes: dict[tuple[str, str], Any] = {}
         self._conn_counter = _it.count()
 
@@ -476,7 +482,6 @@ class PartitionedServer:
     # the raw record's client id, so the lookup is exact
     def _route_nack(self, document_id: str, client_id: str,
                     nack) -> None:
-        self.svc.nacks.append((document_id, client_id, nack))
         route = self._nack_routes.get((document_id, client_id))
         if route is not None:
             route[1](nack)

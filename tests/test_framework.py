@@ -271,3 +271,100 @@ def test_new_edit_clears_redo():
     assert stack.redo_count == 1
     kv.set("b", 9)  # a new edit invalidates redo history
     assert stack.redo_count == 0
+
+
+# ----------------------------------------------------------------------
+# framework helpers (oldest-client-observer, dds-interceptions,
+# request-handler — packages/framework/*)
+
+def test_oldest_client_observer_tracks_join_order():
+    from fluidframework_tpu.framework import OldestClientObserver
+    from fluidframework_tpu.protocol.messages import ClientDetail
+    from fluidframework_tpu.protocol.quorum import QuorumClients
+
+    q = QuorumClients()
+    q.add_member("a", ClientDetail("a"))
+    q.add_member("b", ClientDetail("b"))
+    obs_b = OldestClientObserver(q, "b")
+    assert not obs_b.is_oldest()
+    events = []
+    obs_b.on("becameOldest", lambda: events.append("became"))
+    obs_b.on("lostOldest", lambda: events.append("lost"))
+    q.remove_member("a")  # oldest leaves -> b inherits
+    assert obs_b.is_oldest()
+    assert events == ["became"]
+    q.add_member("c", ClientDetail("c"))
+    assert obs_b.is_oldest()  # later joins never preempt
+
+
+def test_intercepted_string_stamps_props():
+    from fluidframework_tpu.framework import (
+        create_shared_string_with_interception,
+    )
+    from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+    s = ContainerSession(["A", "B"])
+    for c in ("A", "B"):
+        s.runtime(c).create_datastore("ds").create_channel(
+            "sharedstring", "t")
+    s.process_all()
+    raw_a = s.runtime("A").get_datastore("ds").get_channel("t")
+    raw_b = s.runtime("B").get_datastore("ds").get_channel("t")
+
+    def stamp(pos, props):
+        return dict(props or {}, author="alice")
+
+    wrapped = create_shared_string_with_interception(raw_a, stamp)
+    wrapped.insert_text(0, "hi", {"bold": 1})
+    s.process_all()
+    # the interception stamped the LOCAL edit; remote replica sees it
+    sig_b = raw_b.signature()
+    assert raw_a.signature() == sig_b
+    assert wrapped.get_text() == "hi"  # reads pass through
+
+
+def test_intercepted_map_can_rewrite_and_veto():
+    from fluidframework_tpu.framework import (
+        create_shared_map_with_interception,
+    )
+    from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+    s = ContainerSession(["A"])
+    s.runtime("A").create_datastore("ds").create_channel(
+        "sharedmap", "m")
+    raw = s.runtime("A").get_datastore("ds").get_channel("m")
+
+    def interceptor(key, value):
+        if key.startswith("_"):
+            raise PermissionError("reserved key")
+        return {"v": value, "by": "alice"}
+
+    wrapped = create_shared_map_with_interception(raw, interceptor)
+    wrapped.set("k", 42)
+    s.process_all()
+    assert raw.get("k") == {"v": 42, "by": "alice"}
+    import pytest as _pytest
+
+    with _pytest.raises(PermissionError):
+        wrapped.set("_internal", 1)
+
+
+def test_request_handler_routes_paths():
+    from fluidframework_tpu.framework import (
+        RequestHandlerError,
+        build_request_handler,
+        datastore_channel_handler,
+    )
+    from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+    import pytest as _pytest
+
+    s = ContainerSession(["A"])
+    ds = s.runtime("A").create_datastore("ds")
+    chan = ds.create_channel("sharedmap", "m")
+    route = build_request_handler(datastore_channel_handler)
+    rt = s.runtime("A")
+    assert route("/ds", rt) is ds
+    assert route("/ds/m", rt) is chan
+    with _pytest.raises(RequestHandlerError) as e:
+        route("/nope", rt)
+    assert e.value.status == 404

@@ -247,3 +247,18 @@ def test_partitioned_server_behind_ingress():
         asyncio.run_coroutine_threadsafe(_shutdown(), loop)
         t.join(timeout=10)
         loop.close()
+
+
+def test_durable_layout_marker_refuses_mismatch(tmp_path):
+    """Restarting a durable data dir under a different partition
+    layout must refuse loudly (history would be ignored/misrouted)."""
+    from fluidframework_tpu.service.ingress import _check_durable_layout
+
+    d = str(tmp_path / "data")
+    _check_durable_layout(d, partitions=4)
+    _check_durable_layout(d, partitions=4)  # same layout: fine
+    with pytest.raises(SystemExit, match="refusing to start"):
+        _check_durable_layout(d, partitions=8)
+    with pytest.raises(SystemExit, match="refusing to start"):
+        _check_durable_layout(d, partitions=0)
+    _check_durable_layout(None, partitions=2)  # non-durable: no-op
