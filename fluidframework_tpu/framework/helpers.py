@@ -80,7 +80,10 @@ class InterceptedSharedString:
     def annotate_range(self, start: int, end: int,
                        props: dict) -> None:
         merged = self._interceptor(start, props)
-        self._string.annotate_range(start, end, merged or props)
+        # an interceptor returning {} means "strip the props", not
+        # "fall back to the originals" — only None defers
+        self._string.annotate_range(
+            start, end, merged if merged is not None else props)
 
     def __getattr__(self, name: str):  # reads + everything else
         return getattr(self._string, name)

@@ -62,7 +62,10 @@ def apply_window_impl(table: SegmentTable, batch: OpBatch) -> SegmentTable:
     def step(carry, op):
         return fused_step(carry, op), None
 
-    unroll = 4 if jax.default_backend() == "tpu" else 1
+    if jax.default_backend() == "tpu":
+        unroll = int(os.environ.get("FFTPU_UNROLL", "4"))
+    else:
+        unroll = 1
     st, _ = jax.lax.scan(step, st, ops_wd, unroll=unroll)
     return state_to_table(st, SegmentTable)
 

@@ -351,6 +351,15 @@ def _check_durable_layout(data_dir: Optional[str],
                 "original flags or a fresh --data-dir."
             )
         return
+    if _os.path.isdir(data_dir) and _os.listdir(data_dir):
+        # data without a marker (pre-marker release or foreign dir):
+        # adopting a layout could silently orphan that history
+        raise SystemExit(
+            f"data dir {data_dir!r} contains data but no layout.json; "
+            "refusing to guess its layout. Create layout.json "
+            f"({current} for the current flags) after verifying, or "
+            "use a fresh --data-dir."
+        )
     _os.makedirs(data_dir, exist_ok=True)
     with open(marker, "w") as f:
         _json.dump(current, f)

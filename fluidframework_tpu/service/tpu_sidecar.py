@@ -42,6 +42,164 @@ from ..ops.segment_table import KIND_NOOP
 from ..protocol.messages import MessageType, SequencedMessage
 
 
+def _pack_rows(n_rows: int, ops_by_row: dict,
+               bucket_floor: int = 16) -> dict:
+    """Pack per-row op lists into padded [n_rows, bucket] arrays with
+    power-of-two window bucketing — THE op-packing recipe (one
+    definition; the primary dispatch, the grow/replay ladders, and the
+    pool all use it, so the fill/bucket policy cannot drift)."""
+    window = max((len(v) for v in ops_by_row.values()), default=0)
+    bucket = bucket_floor
+    while bucket < window:
+        bucket *= 2
+    arrays = {f: np.zeros((n_rows, bucket), np.int32)
+              for f in OP_FIELDS}
+    arrays["kind"][:] = KIND_NOOP
+    for row, ops in ops_by_row.items():
+        for w, op in enumerate(ops):
+            for f in OP_FIELDS:
+                arrays[f][row, w] = op[f]
+    return arrays
+
+
+def _replay_chunked(apply_fn, table, ops_by_row: dict,
+                    chunk: int = 256):
+    """Re-replay full per-row op histories in fixed-size chunked
+    dispatches (the regrow/admission recipe)."""
+    n_rows = table.docs
+    longest = max((len(v) for v in ops_by_row.values()), default=0)
+    for start in range(0, longest, chunk):
+        arrays = _pack_rows(
+            n_rows,
+            {r: ops[start:start + chunk]
+             for r, ops in ops_by_row.items()},
+            bucket_floor=chunk,
+        )
+        table = apply_fn(table, arrays)
+    return table
+
+
+class SeqShardedPool:
+    """Long-document tier (SURVEY §5.7 in the PRODUCT path): documents
+    that outgrow the primary slab ladder move to a table whose SLOT
+    axis is sharded across a device mesh — per-document capacity =
+    n_seq_devices x the primary ladder top — instead of leaving the
+    device path entirely (host eviction becomes the LAST resort, for
+    documents that exceed even the pooled capacity or are
+    tensor-inexpressible).
+
+    Admissions are rare (a document must exhaust the primary ladder),
+    so the pool keeps its machinery simple and correct: admitting
+    rebuilds the pool table at the next power-of-two row count and
+    re-replays every member's canonical encoded stream in chunked
+    sequence-sharded dispatches (same recipe as the primary ladder's
+    regrow)."""
+
+    def __init__(self, mesh, per_doc_capacity: int):
+        from ..parallel.seq_shard import SEQ_AXIS
+
+        n_seq = mesh.shape[SEQ_AXIS]
+        if per_doc_capacity % n_seq or per_doc_capacity // n_seq < 2:
+            raise ValueError(
+                f"pool capacity {per_doc_capacity} invalid for "
+                f"{n_seq}-way seq mesh"
+            )
+        doc_axes = [a for a in mesh.axis_names if a != SEQ_AXIS]
+        if doc_axes and mesh.shape[doc_axes[0]] != 1:
+            raise ValueError(
+                "pool requires an unsharded doc axis (doc_shards=1): "
+                "row admissions don't track a sharded row axis"
+            )
+        self.mesh = mesh
+        self.capacity = per_doc_capacity
+        self.members: list[int] = []      # sidecar slot per pool row
+        self.row_of: dict[int, int] = {}  # sidecar slot -> row
+        self._table = None
+
+    def _bucket(self) -> int:
+        n = max(1, len(self.members))
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _apply(self, table, arrays):
+        from ..parallel import apply_window_seq_sharded
+
+        return apply_window_seq_sharded(
+            table, OpBatch(**arrays), self.mesh
+        )
+
+    def _replay_all(self, streams) -> None:
+        """Rebuild the pool table and re-replay every member's stream
+        (chunked sequence-sharded dispatches)."""
+        if not self.members:
+            self._table = None
+            return
+        table = make_table(self._bucket(), self.capacity)
+        self._table = _replay_chunked(
+            self._apply, table,
+            {row: streams[slot].ops
+             for row, slot in enumerate(self.members)},
+        )
+
+    def admit(self, slots: list, streams) -> list:
+        """Admit sidecar slots; returns the slots that FAILED (exceed
+        even pooled capacity) and were rolled back out."""
+        for slot in slots:
+            if slot not in self.row_of:
+                self.row_of[slot] = len(self.members)
+                self.members.append(slot)
+        self._replay_all(streams)
+        failed = self.overflowed_slots()
+        if failed:
+            for slot in failed:
+                self.remove(slot)
+            self._replay_all(streams)
+        return failed
+
+    def remove(self, slot: int) -> None:
+        """Bookkeeping only — the table still holds the removed row's
+        data and flags at the OLD indices. Callers MUST follow with
+        rebuild()/ _replay_all() before the next read or dispatch, or
+        remaining members read the wrong rows and stale overflow flags
+        evict innocent documents."""
+        if slot not in self.row_of:
+            return
+        row = self.row_of.pop(slot)
+        self.members.pop(row)
+        for s2, r2 in self.row_of.items():
+            if r2 > row:
+                self.row_of[s2] = r2 - 1
+
+    def rebuild(self, streams) -> None:
+        self._replay_all(streams)
+
+    def dispatch(self, packed_by_slot: dict) -> list:
+        """Apply queued window ops for pooled docs; returns slots that
+        overflowed the pool."""
+        if self._table is None or not packed_by_slot:
+            return []
+        arrays = _pack_rows(self._table.docs, {
+            self.row_of[slot]: ops
+            for slot, ops in packed_by_slot.items()
+            if slot in self.row_of
+        })
+        self._table = self._apply(self._table, arrays)
+        return self.overflowed_slots()
+
+    def overflowed_slots(self) -> list:
+        if self._table is None:
+            return []
+        flags = np.asarray(self._table.overflow)
+        return [self.members[r]
+                for r in np.nonzero(flags)[0].tolist()
+                if r < len(self.members)]
+
+    def fetch(self):
+        return fetch(self._table)
+
+
 class TpuMergeSidecar:
     """Batched merge state for up to ``max_docs`` sequence channels.
 
@@ -52,10 +210,22 @@ class TpuMergeSidecar:
     """
 
     def __init__(self, max_docs: int = 1024, capacity: int = 1024,
-                 compact_every: int = 8, max_capacity: int = 16384):
+                 compact_every: int = 8, max_capacity: int = 16384,
+                 seq_mesh=None, pool_capacity: Optional[int] = None):
         self.max_docs = max_docs
         self.capacity = capacity
         self.max_capacity = max_capacity
+        # long-document tier: past the ladder top, docs move to a
+        # sequence-sharded pool on this mesh (SURVEY §5.7) before any
+        # host eviction
+        self._pool: Optional[SeqShardedPool] = None
+        if seq_mesh is not None:
+            if pool_capacity is None:
+                from ..parallel.seq_shard import SEQ_AXIS
+
+                pool_capacity = max_capacity * seq_mesh.shape[SEQ_AXIS]
+            self._pool = SeqShardedPool(seq_mesh, pool_capacity)
+        self.pool_admit_count = 0
         self._table = make_table(max_docs, capacity)
         self._slots: dict[tuple[str, str, str], int] = {}
         # per-document slot index: ingest is called once per sequenced
@@ -188,31 +358,39 @@ class TpuMergeSidecar:
         # every flush (20-40s each on the real chip). Pow2 bucketing
         # bounds the shape count to log(n).
         packed = [coalesce_noops(q) for q in self._queued]
-        window = max(len(p) for p in packed)
-        bucket = 16
-        while bucket < window:
-            bucket *= 2
-        arrays = {f: np.zeros((docs, bucket), np.int32)
-                  for f in OP_FIELDS}
-        arrays["kind"][:] = KIND_NOOP
-        real = 0
-        for slot, (queue, ops) in enumerate(
-            zip(self._queued, packed)
-        ):
-            if ops:
-                block = np.array(
-                    [[op[f] for f in OP_FIELDS] for op in ops],
-                    np.int32,
-                )
-                for i, f in enumerate(OP_FIELDS):
-                    arrays[f][slot, : len(ops)] = block[:, i]
-                real += int((block[:, 0] != KIND_NOOP).sum())
+        pool_packed = {}
+        if self._pool is not None:
+            for slot in list(self._pool.row_of):
+                if packed[slot]:
+                    pool_packed[slot] = packed[slot]
+                    packed[slot] = []
+        arrays = _pack_rows(
+            docs, {slot: ops for slot, ops in enumerate(packed) if ops}
+        )
+        real = sum(
+            1 for ops in packed for op in ops
+            if op["kind"] != KIND_NOOP
+        )
+        for queue in self._queued:
             queue.clear()
         self._table = apply_window(self._table, OpBatch(**arrays))
+        if pool_packed:
+            real += sum(
+                1 for ops in pool_packed.values()
+                for op in ops if op["kind"] != KIND_NOOP
+            )
+            overflowed = self._pool.dispatch(pool_packed)
+            for slot in overflowed:
+                self._evict(slot)  # beyond even pooled capacity
+            if overflowed:
+                # _evict only unbooks the row: rebuild so remaining
+                # members' rows and flags are consistent again
+                self._pool.rebuild(self._streams)
         return real
 
     # ------------------------------------------------------------------
-    # overflow recovery: grow ladder, then host eviction
+    # overflow recovery: grow ladder, then seq-sharded pool, then
+    # host eviction
 
     def _recover(self) -> None:
         while True:
@@ -221,6 +399,12 @@ class TpuMergeSidecar:
                 return
             if self.capacity * 2 <= self.max_capacity:
                 self._grow(self.capacity * 2)
+            elif self._pool is not None:
+                slots = overflowed.tolist()
+                failed = self._admit_to_pool(slots)
+                for slot in failed:
+                    self._evict(slot)
+                return
             else:
                 for slot in overflowed.tolist():
                     self._evict(slot)
@@ -233,26 +417,43 @@ class TpuMergeSidecar:
         moment one op was skipped)."""
         self.grow_count += 1
         self.capacity = new_capacity
-        self._table = make_table(self.max_docs, new_capacity)
-        chunk = 256
-        longest = max(
-            (len(s.ops) for s in self._streams), default=0
+
+        def apply_and_compact(table, arrays):
+            return compact(apply_window(table, OpBatch(**arrays)))
+
+        self._table = _replay_chunked(
+            apply_and_compact,
+            make_table(self.max_docs, new_capacity),
+            {
+                slot: stream.ops
+                for slot, stream in enumerate(self._streams)
+                if slot not in self._host
+                and not (self._pool is not None
+                         and slot in self._pool.row_of)
+            },
         )
-        for start in range(0, longest, chunk):
-            arrays = {f: np.zeros((self.max_docs, chunk), np.int32)
-                      for f in OP_FIELDS}
-            arrays["kind"][:] = KIND_NOOP
-            for slot, stream in enumerate(self._streams):
-                if slot in self._host:
-                    continue
-                for w, op in enumerate(stream.ops[start:start + chunk]):
-                    for f in OP_FIELDS:
-                        arrays[f][slot, w] = op[f]
-            self._table = apply_window(self._table, OpBatch(**arrays))
-            self._table = compact(self._table)
         # everything queued was part of the replayed streams
         for queue in self._queued:
             queue.clear()
+
+    def _admit_to_pool(self, slots: list) -> list:
+        """Move slots to the sequence-sharded pool; retire their
+        primary rows. Returns slots the pool could not hold."""
+        failed = self._pool.admit(slots, self._streams)
+        admitted = [s for s in slots if s not in failed]
+        self.pool_admit_count += len(admitted)
+        if admitted:
+            count = np.asarray(self._table.count).copy()
+            overflow = np.asarray(self._table.overflow).copy()
+            for slot in admitted:
+                count[slot] = 0
+                overflow[slot] = 0
+                self._queued[slot].clear()  # replayed from the stream
+            self._table = self._table._replace(
+                count=jnp.asarray(count),
+                overflow=jnp.asarray(overflow),
+            )
+        return failed
 
     def _evict(self, slot: int) -> None:
         """Move one document to a host-side scalar oracle replica —
@@ -263,6 +464,8 @@ class TpuMergeSidecar:
         from ..ops.host_bridge import decode_stream
 
         self.evict_count += 1
+        if self._pool is not None:
+            self._pool.remove(slot)
         obs = MergeTreeClient(f"sidecar-host-{slot}")
         obs.start_collaboration(f"sidecar-host-{slot}")
         self._host[slot] = obs
@@ -291,6 +494,11 @@ class TpuMergeSidecar:
         slot = self._slot(document_id, datastore_id, channel_id)
         if slot in self._host:
             return self._host[slot].get_text()
+        if self._pool is not None and slot in self._pool.row_of:
+            return extract_text(
+                self._pool.fetch(), self._streams[slot],
+                self._pool.row_of[slot],
+            )
         return extract_text(fetch(self._table), self._streams[slot], slot)
 
     def signature(self, document_id: str, datastore_id: str,
@@ -298,6 +506,11 @@ class TpuMergeSidecar:
         slot = self._slot(document_id, datastore_id, channel_id)
         if slot in self._host:
             return self._host_signature(slot)
+        if self._pool is not None and slot in self._pool.row_of:
+            return extract_signature(
+                self._pool.fetch(), self._streams[slot],
+                self._pool.row_of[slot],
+            )
         return extract_signature(
             fetch(self._table), self._streams[slot], slot
         )
@@ -309,6 +522,9 @@ class TpuMergeSidecar:
 
     def host_mode_docs(self) -> int:
         return len(self._host)
+
+    def pooled_docs(self) -> int:
+        return len(self._pool.members) if self._pool else 0
 
     def overflowed(self) -> bool:
         """True only if a document is CURRENTLY wrong (should never

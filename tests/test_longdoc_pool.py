@@ -1,0 +1,132 @@
+"""Long-document tier (SURVEY §5.7 in the product path): documents
+that outgrow the primary slab ladder move to the SEQUENCE-SHARDED pool
+(slot axis split across the 8-device mesh) and stay on the device
+path; host eviction only past even the pooled capacity.
+"""
+import jax
+import pytest
+
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.parallel import make_seq_mesh
+from fluidframework_tpu.service import LocalServer, TpuMergeSidecar
+
+
+def make_pool_sidecar(max_docs=3, capacity=16, max_capacity=32,
+                      pool_capacity=256):
+    mesh = make_seq_mesh(jax.devices())  # 1 doc lane x 8 seq shards
+    return TpuMergeSidecar(
+        max_docs=max_docs, capacity=capacity,
+        max_capacity=max_capacity, seq_mesh=mesh,
+        pool_capacity=pool_capacity,
+    )
+
+
+def write_doc(server, sidecar, doc, n_chunks, chunk="abcdefgh"):
+    factory = LocalDocumentServiceFactory(server)
+    sidecar.subscribe(server, doc, "d", "s")
+    c = Container.load(factory.create_document_service(doc),
+                       client_id=f"{doc}-w")
+    s = c.runtime.create_datastore("d").create_channel(
+        "sharedstring", "s")
+    for i in range(n_chunks):
+        s.insert_text(0, chunk)
+        c.flush()
+        if i % 3 == 2 and s.get_length() > 6:
+            s.remove_text(2, 5)
+            c.flush()
+    return c, s
+
+
+def test_overgrown_doc_lands_in_pool_not_host():
+    server = LocalServer()
+    sidecar = make_pool_sidecar()
+    c, s = write_doc(server, sidecar, "big", n_chunks=60)
+    sidecar.apply()
+    assert sidecar.pool_admit_count >= 1
+    assert sidecar.pooled_docs() == 1
+    assert sidecar.host_mode_docs() == 0, \
+        "pool must catch the doc before host eviction"
+    assert sidecar.text("big", "d", "s") == s.get_text()
+
+
+def test_pooled_doc_keeps_collaborating():
+    server = LocalServer()
+    sidecar = make_pool_sidecar()
+    c, s = write_doc(server, sidecar, "big", n_chunks=60)
+    sidecar.apply()
+    assert sidecar.pooled_docs() == 1
+    # continued edits dispatch through the seq-sharded window path
+    for _ in range(10):
+        s.insert_text(3, "XYZ")
+        c.flush()
+    sidecar.apply()
+    assert sidecar.pooled_docs() == 1
+    assert sidecar.host_mode_docs() == 0
+    assert sidecar.text("big", "d", "s") == s.get_text()
+
+
+def test_mixed_primary_and_pooled_docs_converge():
+    server = LocalServer()
+    sidecar = make_pool_sidecar(max_docs=3)
+    big_c, big_s = write_doc(server, sidecar, "big", n_chunks=60)
+    small_c, small_s = write_doc(server, sidecar, "small", n_chunks=4)
+    sidecar.apply()
+    assert sidecar.pooled_docs() == 1
+    # both tiers keep taking edits in the same apply cycle
+    big_s.insert_text(0, "B")
+    big_c.flush()
+    small_s.insert_text(0, "S")
+    small_c.flush()
+    sidecar.apply()
+    assert sidecar.text("big", "d", "s") == big_s.get_text()
+    assert sidecar.text("small", "d", "s") == small_s.get_text()
+    assert sidecar.host_mode_docs() == 0
+
+
+def test_beyond_pool_capacity_falls_back_to_host():
+    server = LocalServer()
+    # pool holds only 64 slots/doc: a doc that beats the ladder AND
+    # the pool must still end up correct (host replica)
+    sidecar = make_pool_sidecar(max_capacity=32, pool_capacity=64)
+    c, s = write_doc(server, sidecar, "huge", n_chunks=120)
+    sidecar.apply()
+    assert sidecar.host_mode_docs() == 1
+    assert sidecar.pooled_docs() == 0
+    assert sidecar.text("huge", "d", "s") == s.get_text()
+
+
+def test_pool_rejects_sharded_doc_axis():
+    from fluidframework_tpu.service.tpu_sidecar import SeqShardedPool
+
+    mesh = make_seq_mesh(jax.devices(), doc_shards=2)
+    with pytest.raises(ValueError, match="unsharded doc axis"):
+        SeqShardedPool(mesh, 256)
+
+
+def test_pool_eviction_does_not_corrupt_remaining_members():
+    """Regression: evicting one pooled doc (dispatch overflow) used to
+    leave the other members' rows unshifted — wrong text reads and
+    spurious evictions from stale overflow flags."""
+    server = LocalServer()
+    sidecar = make_pool_sidecar(max_docs=3, max_capacity=32,
+                                pool_capacity=128)
+    a_c, a_s = write_doc(server, sidecar, "doc-a", n_chunks=60)
+    b_c, b_s = write_doc(server, sidecar, "doc-b", n_chunks=60)
+    sidecar.apply()
+    assert sidecar.pooled_docs() == 2
+    # grow doc-a past the pool capacity through the dispatch path
+    for _ in range(120):
+        a_s.insert_text(0, "zzzzzzzz")
+        a_c.flush()
+    sidecar.apply()
+    assert sidecar.host_mode_docs() == 1       # doc-a evicted
+    assert sidecar.pooled_docs() == 1          # doc-b survives
+    # doc-b's reads stay correct, and further edits keep applying
+    assert sidecar.text("doc-b", "d", "s") == b_s.get_text()
+    b_s.insert_text(0, "still-alive-")
+    b_c.flush()
+    sidecar.apply()
+    assert sidecar.pooled_docs() == 1, "no spurious eviction"
+    assert sidecar.text("doc-b", "d", "s") == b_s.get_text()
+    assert sidecar.text("doc-a", "d", "s") == a_s.get_text()
