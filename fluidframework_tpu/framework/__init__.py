@@ -7,6 +7,7 @@ from .clients import ContainerServices, LocalServiceClient
 from .data_object import DataObject, DataObjectFactory, PureDataObject
 from .fluid_static import FluidContainer
 from .helpers import (
+    AgentScheduler,
     OldestClientObserver,
     RequestHandlerError,
     RequestParser,
@@ -22,6 +23,7 @@ from .undo_redo import (
 )
 
 __all__ = [
+    "AgentScheduler",
     "ContainerServices",
     "DataObject",
     "DataObjectFactory",

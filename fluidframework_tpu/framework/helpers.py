@@ -171,3 +171,62 @@ def datastore_channel_handler(parser: RequestParser, runtime) -> Any:
         return ds.get_channel(parser.path_parts[1])
     except KeyError:
         return None
+
+
+# ----------------------------------------------------------------------
+# agent scheduler
+
+
+class AgentScheduler(EventEmitter):
+    """packages/framework/agent-scheduler: register named tasks with
+    worker callbacks; exactly ONE connected client runs each task at a
+    time (election rides the TaskManager DDS's sequenced volunteer
+    queue), with automatic re-election when the assignee leaves.
+
+    Events: ``picked(task_id)`` when this client wins a task,
+    ``released(task_id)`` when it loses/abandons one.
+    """
+
+    def __init__(self, task_manager):
+        super().__init__()
+        self._tasks = task_manager
+        self._workers: dict[str, Callable[[], None]] = {}
+        self._running: set[str] = set()
+        task_manager.on("assigned", self._on_change)
+        task_manager.on("queueChanged", self._on_change)
+
+    def register(self, task_id: str,
+                 worker: Callable[[], None]) -> None:
+        """Volunteer for ``task_id``; ``worker`` runs when (and only
+        while) this client holds the assignment."""
+        self._workers[task_id] = worker
+        if not self._tasks.queued(task_id) \
+                and not self._tasks.have_task(task_id):
+            self._tasks.volunteer(task_id)
+        self._maybe_start(task_id)
+
+    def unregister(self, task_id: str) -> None:
+        self._workers.pop(task_id, None)
+        if task_id in self._running:
+            self._running.discard(task_id)
+            self.emit("released", task_id)
+        self._tasks.abandon(task_id)
+
+    def picked_tasks(self) -> list[str]:
+        return sorted(self._running)
+
+    def _maybe_start(self, task_id: str) -> None:
+        if task_id in self._running:
+            return
+        if task_id in self._workers and self._tasks.have_task(task_id):
+            self._running.add(task_id)
+            self.emit("picked", task_id)
+            self._workers[task_id]()
+
+    def _on_change(self, task_id: str, *_):
+        if task_id in self._running \
+                and not self._tasks.have_task(task_id):
+            self._running.discard(task_id)
+            self.emit("released", task_id)
+        else:
+            self._maybe_start(task_id)

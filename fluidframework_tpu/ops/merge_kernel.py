@@ -46,6 +46,19 @@ from .merge_step import (
 from .segment_table import NOT_REMOVED, OpBatch, SegmentTable
 
 
+def _env_unroll() -> int:
+    """TPU scan unroll, read ONCE at import (jit caches per shape, so
+    later env changes would be silently ignored anyway — measured on
+    the tunneled v5e: 4 is best at window ~70, see TPU_EVIDENCE.md)."""
+    try:
+        return max(1, int(os.environ.get("FFTPU_UNROLL", "4")))
+    except ValueError:
+        return 4
+
+
+_TPU_UNROLL = _env_unroll()
+
+
 def apply_window_impl(table: SegmentTable, batch: OpBatch) -> SegmentTable:
     """XLA executor: scan the fused step over the [docs, window] batch.
     Pure/jittable; doc axis shards cleanly under shard_map.
@@ -62,10 +75,7 @@ def apply_window_impl(table: SegmentTable, batch: OpBatch) -> SegmentTable:
     def step(carry, op):
         return fused_step(carry, op), None
 
-    if jax.default_backend() == "tpu":
-        unroll = int(os.environ.get("FFTPU_UNROLL", "4"))
-    else:
-        unroll = 1
+    unroll = _TPU_UNROLL if jax.default_backend() == "tpu" else 1
     st, _ = jax.lax.scan(step, st, ops_wd, unroll=unroll)
     return state_to_table(st, SegmentTable)
 

@@ -126,9 +126,13 @@ class SeqShardedPool:
     def _apply(self, table, arrays):
         from ..parallel import apply_window_seq_sharded
 
-        return apply_window_seq_sharded(
+        # compact after every pool dispatch: remove-heavy histories
+        # otherwise accumulate dead segments until they overflow a
+        # pool that could easily hold the live text (the primary
+        # ladder's _grow compacts per chunk for the same reason)
+        return compact(apply_window_seq_sharded(
             table, OpBatch(**arrays), self.mesh
-        )
+        ))
 
     def _replay_all(self, streams) -> None:
         """Rebuild the pool table and re-replay every member's stream
@@ -137,10 +141,16 @@ class SeqShardedPool:
             self._table = None
             return
         table = make_table(self._bucket(), self.capacity)
+        # chunk must leave headroom for the WORST-CASE transient
+        # growth inside one chunk (each op can add 2 slots; compaction
+        # only runs between chunks): chunk=256 against a small pool
+        # would overflow on history alone even when the live set fits
+        chunk = max(16, min(256, self.capacity // 4))
         self._table = _replay_chunked(
             self._apply, table,
             {row: streams[slot].ops
              for row, slot in enumerate(self.members)},
+            chunk=chunk,
         )
 
     def admit(self, slots: list, streams) -> list:
@@ -379,13 +389,9 @@ class TpuMergeSidecar:
                 1 for ops in pool_packed.values()
                 for op in ops if op["kind"] != KIND_NOOP
             )
-            overflowed = self._pool.dispatch(pool_packed)
-            for slot in overflowed:
+            for slot in self._pool.dispatch(pool_packed):
                 self._evict(slot)  # beyond even pooled capacity
-            if overflowed:
-                # _evict only unbooks the row: rebuild so remaining
-                # members' rows and flags are consistent again
-                self._pool.rebuild(self._streams)
+                # (_evict rebuilds the pool for the survivors)
         return real
 
     # ------------------------------------------------------------------
@@ -464,8 +470,13 @@ class TpuMergeSidecar:
         from ..ops.host_bridge import decode_stream
 
         self.evict_count += 1
-        if self._pool is not None:
+        if self._pool is not None and slot in self._pool.row_of:
+            # remove() is bookkeeping only: rebuild HERE so every
+            # eviction path (dispatch overflow, ingest's
+            # tensor-inexpressible ValueError, pool-admission failure)
+            # leaves the remaining members' rows consistent
             self._pool.remove(slot)
+            self._pool.rebuild(self._streams)
         obs = MergeTreeClient(f"sidecar-host-{slot}")
         obs.start_collaboration(f"sidecar-host-{slot}")
         self._host[slot] = obs

@@ -368,3 +368,34 @@ def test_request_handler_routes_paths():
     with _pytest.raises(RequestHandlerError) as e:
         route("/nope", rt)
     assert e.value.status == 404
+
+
+def test_agent_scheduler_single_runner_and_failover():
+    from fluidframework_tpu.framework import AgentScheduler
+    from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+
+    s = ContainerSession(["A", "B"])
+    for c in ("A", "B"):
+        s.runtime(c).create_datastore("ds").create_channel(
+            "taskmanager", "tm")
+    s.process_all()
+    tm_a = s.runtime("A").get_datastore("ds").get_channel("tm")
+    tm_b = s.runtime("B").get_datastore("ds").get_channel("tm")
+    runs = []
+    sched_a = AgentScheduler(tm_a)
+    sched_b = AgentScheduler(tm_b)
+    sched_a.register("indexer", lambda: runs.append("A"))
+    sched_b.register("indexer", lambda: runs.append("B"))
+    s.process_all()
+    # exactly one client runs the task (first volunteer sequenced)
+    assert runs == ["A"]
+    assert sched_a.picked_tasks() == ["indexer"]
+    assert sched_b.picked_tasks() == []
+    # failover: A leaves -> B picks it up
+    released = []
+    sched_a.on("released", released.append)
+    sched_a.unregister("indexer")
+    assert released == ["indexer"]  # fires on local abandon too
+    s.process_all()
+    assert runs == ["A", "B"]
+    assert sched_b.picked_tasks() == ["indexer"]

@@ -130,3 +130,56 @@ def test_pool_eviction_does_not_corrupt_remaining_members():
     assert sidecar.pooled_docs() == 1, "no spurious eviction"
     assert sidecar.text("doc-b", "d", "s") == b_s.get_text()
     assert sidecar.text("doc-a", "d", "s") == a_s.get_text()
+
+
+def test_ingest_eviction_of_pooled_doc_rebuilds_pool():
+    """Regression: a pooled doc leaving via ingest's tensor-
+    inexpressible path (too many interned props) must rebuild the
+    pool for the survivors."""
+    server = LocalServer()
+    sidecar = make_pool_sidecar(max_docs=3, pool_capacity=256)
+    a_c, a_s = write_doc(server, sidecar, "doc-a", n_chunks=60)
+    b_c, b_s = write_doc(server, sidecar, "doc-b", n_chunks=60)
+    sidecar.apply()
+    assert sidecar.pooled_docs() == 2
+    # doc-a submits an op with more prop keys than PROP_CHANNELS:
+    # encode fails -> ingest evicts doc-a mid-pool
+    a_s.insert_text(0, "X", {f"k{i}": i for i in range(9)})
+    a_c.flush()
+    sidecar.apply()
+    assert sidecar.host_mode_docs() == 1
+    assert sidecar.pooled_docs() == 1
+    assert sidecar.text("doc-a", "d", "s") == a_s.get_text()
+    # survivor reads/edits stay correct
+    assert sidecar.text("doc-b", "d", "s") == b_s.get_text()
+    b_s.insert_text(0, "ok-")
+    b_c.flush()
+    sidecar.apply()
+    assert sidecar.pooled_docs() == 1
+    assert sidecar.text("doc-b", "d", "s") == b_s.get_text()
+
+
+def test_remove_heavy_doc_fits_pool_after_compaction():
+    """Regression: pool replay/dispatch compact — a doc whose HISTORY
+    exceeds pooled capacity but whose live text fits must stay pooled,
+    not fall through to host eviction."""
+    server = LocalServer()
+    sidecar = make_pool_sidecar(max_docs=2, max_capacity=32,
+                                pool_capacity=64)
+    factory = LocalDocumentServiceFactory(server)
+    sidecar.subscribe(server, "churn", "d", "s")
+    c = Container.load(factory.create_document_service("churn"),
+                       client_id="w")
+    s = c.runtime.create_datastore("d").create_channel(
+        "sharedstring", "s")
+    # insert/remove churn: ~160 historical segments, few live ones
+    for i in range(80):
+        s.insert_text(0, "abcd")
+        c.flush()
+        if s.get_length() > 8:
+            s.remove_text(0, 4)
+            c.flush()
+    sidecar.apply()
+    assert sidecar.host_mode_docs() == 0, \
+        "compaction should keep the live set inside the pool"
+    assert sidecar.text("churn", "d", "s") == s.get_text()

@@ -124,3 +124,43 @@ def test_seq_sharded_rejects_single_slot_shards():
     table = make_table(1, 8)  # 1 slot per shard on the 8-way mesh
     with pytest.raises(ValueError, match="shard width"):
         apply_window_seq_sharded(table, batch, mesh)
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_seq_sharded_adversarial_fuzz(seed):
+    """Heavier differential load on the collective path: more clients,
+    remove/annotate storms, longer streams — every field bit-identical
+    to the single-device executor (the collective prefix sums, point
+    lookups, and boundary exchanges all on the hot path)."""
+    mesh = make_seq_mesh(jax.devices())
+    text, stream = record_op_stream(FuzzConfig(
+        n_clients=6, n_steps=220, seed=seed * 73 + 11,
+        remove_weight=0.35, annotate_weight=0.2,
+    ))
+    encs, ref, shd = _run_both([stream], capacity=1024, mesh=mesh)
+    _assert_tables_equal(ref, shd)
+    assert extract_text(shd, encs[0], 0) == text
+
+
+def test_seq_sharded_ops_spanning_shard_boundaries():
+    """Directed: removes and annotates whose ranges cross shard
+    boundaries (the two-split restructure with both boundary slots in
+    different shards, exercising the ppermute exchange)."""
+    from fluidframework_tpu.testing import MockCollabSession
+
+    stream = []
+    s = MockCollabSession(["A", "B"], stream_log=stream)
+    # build a doc whose segments straddle the 8 x 64-slot shards
+    for i in range(100):
+        s.do("A", "insert_text_local", 0, f"seg{i:03d}-")
+    s.process_all()
+    # cross-boundary range operations
+    s.do("B", "remove_range_local", 50, 450)
+    s.do("A", "annotate_range_local", 10, 700, {"bold": 1})
+    s.do("B", "insert_text_local", 200, "XBOUNDARYX")
+    s.process_all()
+    expected = s.assert_converged()
+    mesh = make_seq_mesh(jax.devices())
+    encs, ref, shd = _run_both([stream], capacity=512, mesh=mesh)
+    _assert_tables_equal(ref, shd)
+    assert extract_text(shd, encs[0], 0) == expected
