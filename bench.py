@@ -291,8 +291,13 @@ def stage_config1(scale: str, reps: int, cooldown: float) -> dict:
 def stage_config2(scale: str, reps: int, cooldown: float) -> dict:
     """BASELINE #2: N docs x concurrent clients typing, one batched
     dispatch across all docs — the headline throughput config."""
+    # full-scale docs raised 1024 -> 4096 (round 3): the per-step cost
+    # is launch-overhead-dominated and nearly flat in docs until HBM
+    # saturates, so widening the batch axis is free throughput
+    # (measured on-chip: 0.30 -> 0.55M ops/s from 1024 -> 4096 docs at
+    # capacity 512; 16384 regresses — HBM thrashing). TPU_EVIDENCE.md.
     docs, base, steps, clients, capacity = {
-        "full": (1024, 16, 220, 4, 1024),
+        "full": (4096, 16, 220, 4, 1024),
         "cpu": (64, 8, 120, 3, 512),
         "smoke": (16, 4, 60, 3, 512),
     }[scale]
