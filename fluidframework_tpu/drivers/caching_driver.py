@@ -197,10 +197,12 @@ class _DocumentFacade:
 
     def read_ops(self, from_seq: int, to_seq=None):
         return self._client._doc_read_ops(
-            self.document_id, from_seq, to_seq)
+            self.document_id, from_seq, to_seq,
+            auth=(self.tenant_id, self.token))
 
     def get_latest_summary(self):
-        return self._client._doc_latest_summary(self.document_id)
+        return self._client._doc_latest_summary(
+            self.document_id, auth=(self.tenant_id, self.token))
 
     def close(self) -> None:
         # tell the server to drop this document's connection (leave

@@ -248,27 +248,39 @@ class SocketDocumentService:
 
     def read_ops(self, from_seq: int,
                  to_seq: Optional[int] = None) -> list[SequencedMessage]:
-        return self._doc_read_ops(self.document_id, from_seq, to_seq)
+        # storage-plane requests carry the token: the loader reads
+        # snapshot + ops BEFORE connect_document
+        return self._doc_read_ops(self.document_id, from_seq, to_seq,
+                                  auth=(self.tenant_id, self.token))
 
     def get_latest_summary(self) -> Optional[tuple[int, dict]]:
-        return self._doc_latest_summary(self.document_id)
+        return self._doc_latest_summary(
+            self.document_id, auth=(self.tenant_id, self.token))
 
     # single definitions of the request planes, parameterized by
-    # document so the multiplexed facades reuse them verbatim
+    # document so the multiplexed facades reuse them verbatim; ``auth``
+    # lets a facade supply ITS document's (tenant_id, token) over the
+    # shared transport
     def _doc_read_ops(self, document_id: str, from_seq: int,
-                      to_seq: Optional[int] = None
+                      to_seq: Optional[int] = None, auth=None
                       ) -> list[SequencedMessage]:
-        frame = self._request({
+        data = {
             "type": "read_ops", "document_id": document_id,
             "from_seq": from_seq, "to_seq": to_seq,
-        })
+        }
+        if auth is not None and auth[1] is not None:
+            data["tenant_id"], data["token"] = auth
+        frame = self._request(data)
         return [message_from_json(m) for m in frame["msgs"]]
 
-    def _doc_latest_summary(self, document_id: str
+    def _doc_latest_summary(self, document_id: str, auth=None
                             ) -> Optional[tuple[int, dict]]:
-        frame = self._request({
+        data = {
             "type": "fetch_summary", "document_id": document_id,
-        })
+        }
+        if auth is not None and auth[1] is not None:
+            data["tenant_id"], data["token"] = auth
+        frame = self._request(data)
         if frame.get("sequence_number") is None:
             return None
         return frame["sequence_number"], decode_contents(frame["summary"])

@@ -215,16 +215,30 @@ class AlfredServer:
                 pass
 
     def _check_read_access(self, session: _ClientSession,
-                           doc: str) -> None:
+                           doc: str, frame: dict) -> None:
         """When tokens are enforced, the storage planes (read_ops /
-        fetch_summary) require a prior successful connect_document for
-        the document — otherwise an unauthenticated socket could read
-        any document's full op log with no credentials."""
-        if self.tenants is not None and doc not in session.authorized:
-            raise PermissionError(
-                f"not authorized for document {doc!r}: "
-                "connect_document with a valid token first"
+        fetch_summary) require either a prior successful
+        connect_document for the document OR a doc:read token on the
+        request itself (the loader fetches snapshot + trailing ops
+        BEFORE joining the delta stream — container.ts load order) —
+        otherwise an unauthenticated socket could read any document's
+        full op log with no credentials."""
+        if self.tenants is None or doc in session.authorized:
+            return
+        from .tenancy import SCOPE_READ, AuthError
+
+        try:
+            self.tenants.validate_token(
+                frame.get("token", ""), frame.get("tenant_id", ""),
+                doc, required_scope=SCOPE_READ,
             )
+        except AuthError as e:
+            raise PermissionError(
+                f"not authorized for document {doc!r}: {e} "
+                "(connect_document first, or send a doc:read token "
+                "with the request)"
+            )
+        session.authorized.add(doc)
 
     def _dispatch(self, session: _ClientSession, frame: dict) -> None:
         kind = frame.get("type")
@@ -295,7 +309,7 @@ class AlfredServer:
                     "message": str(e),
                 })
         elif kind == "read_ops":
-            self._check_read_access(session, doc)
+            self._check_read_access(session, doc, frame)
             msgs = self.local.read_ops(
                 doc, frame["from_seq"], frame.get("to_seq")
             )
@@ -304,7 +318,7 @@ class AlfredServer:
                 "msgs": [message_to_json(m) for m in msgs],
             })
         elif kind == "fetch_summary":
-            self._check_read_access(session, doc)
+            self._check_read_access(session, doc, frame)
             latest = self.local.latest_summary(doc)
             payload: dict[str, Any] = {
                 "type": "summary", "rid": frame.get("rid"),

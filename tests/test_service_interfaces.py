@@ -102,6 +102,39 @@ def test_key_refresh_invalidates_old_tokens():
 
 # ---- authenticated ingress -------------------------------------------
 
+@pytest.fixture()
+def alfred_on_thread():
+    """Start an AlfredServer on a background event loop; yields a
+    factory taking (tenants) and returning the started server."""
+    import asyncio
+    import threading
+
+    state = {}
+
+    def start(tenants=None, local=None):
+        from fluidframework_tpu.service.ingress import AlfredServer
+
+        server = AlfredServer(local, tenants=tenants)
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(server.start())
+            started.set()
+            loop.run_forever()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert started.wait(10)
+        state.update(server=server, loop=loop, thread=t)
+        return server
+
+    yield start
+    if state:
+        state["loop"].call_soon_threadsafe(state["loop"].stop)
+        state["thread"].join(timeout=10)
+
 def test_alfred_rejects_bad_token_and_accepts_good():
     import asyncio
 
@@ -270,44 +303,62 @@ def test_read_mode_submit_nacked_over_socket():
     asyncio.run(scenario())
 
 
-def test_multiplexed_token_refresh_not_sticky():
+def test_multiplexed_token_refresh_not_sticky(alfred_on_thread):
     """Regression: a rejected facade must accept a new token on retry
     (cached facade used to keep the old token + sticky auth_error)."""
-    import asyncio
-    import threading
-
     from fluidframework_tpu.drivers.caching_driver import (
         MultiplexedSocketClient,
     )
-    from fluidframework_tpu.service.ingress import AlfredServer
 
     tm = TenantManager()
     tenant = tm.create_tenant("acme")
-    server = AlfredServer(tenants=tm)
-    loop = asyncio.new_event_loop()
-    started = threading.Event()
+    server = alfred_on_thread(tenants=tm)
+    client = MultiplexedSocketClient("127.0.0.1", server.port,
+                                     timeout=5)
+    bad = client.document_service("d", tenant_id="acme",
+                                  token="junk.tok")
+    with pytest.raises(PermissionError):
+        bad.connect_to_delta_stream("alice", lambda m: None)
+    good_tok = sign_token(tenant.key, "acme", "d", "alice")
+    good = client.document_service("d", tenant_id="acme",
+                                   token=good_tok)
+    conn = good.connect_to_delta_stream("alice", lambda m: None)
+    assert conn.open
+    client.close()
 
-    def run():
-        asyncio.set_event_loop(loop)
-        loop.run_until_complete(server.start())
-        started.set()
-        loop.run_forever()
 
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    assert started.wait(10)
-    try:
-        client = MultiplexedSocketClient("127.0.0.1", server.port,
-                                         timeout=5)
-        bad = client.document_service("d", tenant_id="acme",
-                                      token="junk.tok")
-        with pytest.raises(PermissionError):
-            bad.connect_to_delta_stream("alice", lambda m: None)
-        good_tok = sign_token(tenant.key, "acme", "d", "alice")
-        good = client.document_service("d", tenant_id="acme",
-                                       token=good_tok)
-        conn = good.connect_to_delta_stream("alice", lambda m: None)
-        assert conn.open
-        client.close()
-    finally:
-        loop.call_soon_threadsafe(loop.stop)
+def test_loader_reads_storage_with_token_before_connect(
+        alfred_on_thread):
+    """Regression: Container.load fetches summary + ops BEFORE the
+    delta-stream connect; storage-plane requests must honor the token
+    themselves (found by examples/secure_multitenant.py)."""
+    from fluidframework_tpu.drivers.socket_driver import (
+        SocketDocumentService,
+    )
+    from fluidframework_tpu.loader import Container
+
+    tm = TenantManager()
+    tenant = tm.create_tenant("acme")
+    server = alfred_on_thread(tenants=tm)
+    tok = sign_token(tenant.key, "acme", "d", "alice")
+    svc = SocketDocumentService(
+        "127.0.0.1", server.port, "d",
+        tenant_id="acme", token=tok, timeout=10)
+    with svc.lock:
+        c = Container.load(svc, client_id="alice")  # reads first
+        ch = (c.runtime.create_datastore("ds")
+              .create_channel("sharedstring", "t"))
+        c.flush()
+        ch.insert_text(0, "authed")
+        c.flush()
+    # a second authed client loads the doc purely via storage
+    tok2 = sign_token(tenant.key, "acme", "d", "bob")
+    svc2 = SocketDocumentService(
+        "127.0.0.1", server.port, "d",
+        tenant_id="acme", token=tok2, timeout=10)
+    with svc2.lock:
+        c2 = Container.load(svc2, client_id="bob")
+        got = c2.runtime.get_datastore("ds").get_channel("t")
+        assert got.get_text() == "authed"
+    svc.close()
+    svc2.close()
