@@ -122,6 +122,12 @@ class CachingDocumentService:
             return entry["sequence_number"], entry["summary"]
         try:
             latest = self._inner.get_latest_summary()
+        except PermissionError:
+            # auth rejection is NOT "offline": serving the stale cache
+            # would keep a revoked client reading the document
+            # (PermissionError subclasses OSError — it must be
+            # excluded before the fallback clause)
+            raise
         except (OSError, TimeoutError, ConnectionError, RuntimeError):
             if entry is not None:
                 # offline: a stale snapshot + op catch-up is correct,

@@ -219,7 +219,10 @@ class SocketDocumentService:
             raise ConnectionError("connection closed mid-request")
         frame = slot[0]
         if frame.get("type") == "error":
-            raise RuntimeError(frame.get("message", "server error"))
+            msg = frame.get("message", "server error")
+            if frame.get("error_kind") == "permission":
+                raise PermissionError(msg)
+            raise RuntimeError(msg)
         return frame
 
     # -- DocumentService surface ---------------------------------------

@@ -203,6 +203,12 @@ class AlfredServer:
                     session.send({
                         "type": "error",
                         "rid": frame.get("rid"),
+                        # structured kind: drivers must distinguish an
+                        # auth rejection from a transport/server fault
+                        # (a caching driver would otherwise serve a
+                        # revoked client stale snapshots as "offline")
+                        "error_kind": "permission"
+                        if isinstance(e, PermissionError) else "server",
                         "message": f"{type(e).__name__}: {e}",
                     })
         finally:

@@ -192,6 +192,116 @@ def _fuzz_ink(rng, ink, cid):
     return "clear"
 
 
+def _fuzz_legacy_tree(rng, t, cid):
+    from ..models.legacy_tree import (
+        delete_,
+        insert_tree,
+        move,
+        place_after,
+        place_at_start,
+        place_before,
+        range_of,
+        set_value,
+    )
+
+    view = t.view
+    nodes = [n for n in view.nodes if n != "root"]
+    roll = rng.random()
+    if roll < 0.45 or not nodes:
+        nid = f"n{cid}{rng.getrandbits(32):08x}"
+        spec = [{"definition": "item", "identifier": nid,
+                 "payload": rng.randrange(100)}]
+        if nodes and rng.random() < 0.5:
+            dest = rng.choice([place_before, place_after])(
+                rng.choice(nodes))
+        else:
+            dest = place_at_start("root", f"t{rng.randrange(3)}")
+        t.apply(insert_tree(spec, dest))
+        return f"insert {nid}"
+    target = rng.choice(nodes)
+    rng_range = range_of(place_before(target), place_after(target))
+    if roll < 0.65:
+        t.apply(set_value(target, rng.randrange(100)))
+        return f"set_value {target}"
+    if roll < 0.85:
+        t.apply(delete_(rng_range))
+        return f"delete {target}"
+    t.apply(move(rng_range,
+                 place_at_start("root", f"t{rng.randrange(3)}")))
+    return f"move {target}"
+
+
+def _fuzz_json_ot(rng, j, cid):
+    lst = j.get(["lst"])
+    if lst is None:
+        j.set(["lst"], [])
+        return "init lst"
+    roll = rng.random()
+    if roll < 0.35:
+        j.list_insert(["lst"], rng.randrange(len(lst) + 1),
+                      _word(rng))
+        return "li"
+    if roll < 0.50 and lst:
+        j.list_delete(["lst"], rng.randrange(len(lst)))
+        return "ld"
+    if roll < 0.70:
+        j.set([f"k{rng.randrange(8)}"], rng.randrange(100))
+        return "oi"
+    if roll < 0.80:
+        j.remove([f"k{rng.randrange(8)}"])
+        return "od"
+    key = f"num{rng.randrange(3)}"
+    if j.get([key]) is None:
+        j.set([key], 0)
+        return "init num"
+    j.add([key], rng.randrange(1, 9))
+    return "na"
+
+
+_FUZZ_POINT = {
+    "typeid": "fuzz:pt-1.0.0",
+    "properties": [
+        {"id": "x", "typeid": "Float64"},
+        {"id": "tag", "typeid": "String"},
+    ],
+}
+
+
+def _fuzz_property_tree(rng, pt, cid):
+    if pt.schemas.get(_FUZZ_POINT["typeid"]) is None:
+        pt.schemas.register(_FUZZ_POINT)
+    roll = rng.random()
+    path = f"p{rng.randrange(6)}"
+    if roll < 0.35:
+        if pt.resolve(path) is None:
+            pt.insert_property(
+                path,
+                rng.choice(["Int32", _FUZZ_POINT["typeid"]]))
+            pt.commit()
+            return f"insert {path}"
+        return None
+    if roll < 0.60:
+        node = pt.resolve(path)
+        if node is None:
+            return None
+        if node["typeid"] == "Int32":
+            pt.set_value(path, rng.randrange(100))
+        elif node["typeid"] == _FUZZ_POINT["typeid"]:
+            pt.set_value(f"{path}.x", float(rng.randrange(100)))
+        pt.commit()
+        return f"modify {path}"
+    if roll < 0.75:
+        pt.remove_property(path)
+        pt.commit()
+        return f"remove {path}"
+    # batched multi-edit commit (the squash path)
+    if pt.resolve(path) is None:
+        pt.insert_property(path, "Int32", rng.randrange(10))
+    pt.set_value(path, rng.randrange(100))
+    pt.commit()
+    return f"squash-commit {path}"
+
+
 ACTIONS: dict[str, Callable] = {
     "sharedmap": _fuzz_map,
     "shareddirectory": _fuzz_directory,
@@ -202,6 +312,9 @@ ACTIONS: dict[str, Callable] = {
     "sharedtree": _fuzz_tree,
     "consensusregistercollection": _fuzz_register,
     "ink": _fuzz_ink,
+    "legacysharedtree": _fuzz_legacy_tree,
+    "sharedjson": _fuzz_json_ot,
+    "sharedpropertytree": _fuzz_property_tree,
 }
 
 

@@ -182,3 +182,20 @@ def test_multiplexed_second_client_catches_up(server):
     c2.close()
     factory.close()
     factory2.close()
+
+
+def test_auth_rejection_is_not_served_from_stale_cache():
+    """Regression: a PermissionError from the storage plane must NOT
+    fall back to the cached snapshot (PermissionError subclasses
+    OSError, which the offline clause catches)."""
+    inner = _FakeService()
+    svc = CachingDocumentService(inner, SnapshotCache(), max_age_s=0.0)
+    svc.get_latest_summary()  # populate cache
+    time.sleep(0.01)
+
+    def revoked():
+        raise PermissionError("token expired")
+
+    inner.get_latest_summary = revoked
+    with pytest.raises(PermissionError):
+        svc.get_latest_summary()

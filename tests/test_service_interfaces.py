@@ -105,7 +105,9 @@ def test_key_refresh_invalidates_old_tokens():
 @pytest.fixture()
 def alfred_on_thread():
     """Start an AlfredServer on a background event loop; yields a
-    factory taking (tenants) and returning the started server."""
+    factory taking (tenants) and returning the started server; tears
+    the server down on the loop before stopping it (abandoned handler
+    coroutines otherwise raise 'Event loop is closed' at GC)."""
     import asyncio
     import threading
 
@@ -132,6 +134,12 @@ def alfred_on_thread():
 
     yield start
     if state:
+        fut = asyncio.run_coroutine_threadsafe(
+            state["server"].stop(), state["loop"])
+        try:
+            fut.result(timeout=10)
+        except Exception:
+            pass
         state["loop"].call_soon_threadsafe(state["loop"].stop)
         state["thread"].join(timeout=10)
 
