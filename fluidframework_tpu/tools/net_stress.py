@@ -133,10 +133,14 @@ def worker_main(host: str, port: int, document_id: str,
     }
 
 
-def _spawn_server(port: int) -> tuple[subprocess.Popen, int]:
+def _spawn_server(port: int,
+                  partitions: int = 0) -> tuple[subprocess.Popen, int]:
+    cmd = [sys.executable, "-m", "fluidframework_tpu.service",
+           "--port", str(port)]
+    if partitions > 0:
+        cmd += ["--partitions", str(partitions)]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "fluidframework_tpu.service",
-         "--port", str(port)],
+        cmd,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))),
@@ -152,11 +156,13 @@ def _spawn_server(port: int) -> tuple[subprocess.Popen, int]:
 
 def run_net_stress(n_workers: int = 3, n_ops: int = 30,
                    port: int = 0, seed: int = 1234,
-                   timeout: float = 180.0) -> dict:
-    """Full orchestration; returns a report dict, raises on failure."""
+                   timeout: float = 180.0, partitions: int = 0) -> dict:
+    """Full orchestration; returns a report dict, raises on failure.
+    ``partitions`` > 0 stresses the partitioned queue pipeline shape
+    instead of the inline orderer."""
     repo = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
-    server, port = _spawn_server(port)
+    server, port = _spawn_server(port, partitions)
     try:
         workers = []
         for i in range(n_workers):
@@ -224,9 +230,10 @@ def main(argv: Optional[list] = None) -> int:  # pragma: no cover
     parser.add_argument("--ops", type=int, default=30)
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--partitions", type=int, default=0)
     args = parser.parse_args(argv)
     report = run_net_stress(args.workers, args.ops, args.port,
-                            args.seed)
+                            args.seed, partitions=args.partitions)
     print(json.dumps(report, indent=2))
     return 0
 

@@ -139,3 +139,14 @@ def test_multi_process_stress_converges():
     report = run_net_stress(n_workers=3, n_ops=12, seed=77)
     assert len({w["text_sha"] for w in report["workers"]}) == 1
     assert report["replay_length"] == report["workers"][0]["length"]
+
+
+def test_multi_process_stress_converges_partitioned():
+    """Same multi-process convergence bar, through the PARTITIONED
+    queue pipeline (produce -> broker -> partition consumer -> deli)."""
+    from fluidframework_tpu.tools.net_stress import run_net_stress
+
+    report = run_net_stress(n_workers=3, n_ops=12, seed=78,
+                            partitions=2)
+    assert len({w["text_sha"] for w in report["workers"]}) == 1
+    assert report["replay_length"] == report["workers"][0]["length"]
