@@ -25,6 +25,7 @@ TPU-repo construction:
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -81,16 +82,32 @@ class FileSnapshotCache(SnapshotCache):
                 with open(os.path.join(root, name)) as f:
                     entry = json.load(f)
                 entry["summary"] = decode_contents(entry["summary"])
-                self._entries[name[:-5]] = entry
+                # the real id lives inside the entry; the filename is a
+                # hash (a raw id containing '/' or '..' would escape
+                # the cache root and never be rescanned)
+                doc_id = entry.pop("document_id", name[:-5])
+                # a legacy raw-named file may coexist with the hashed
+                # rewrite of the same document: scan order is
+                # arbitrary, so keep the newer entry
+                prev = self._entries.get(doc_id)
+                if prev is not None and \
+                        prev.get("cached_at", 0) >= entry.get("cached_at", 0):
+                    continue
+                self._entries[doc_id] = entry
             except (ValueError, KeyError, OSError):
                 continue  # corrupt cache entry: treat as miss
 
+    @staticmethod
+    def _filename(document_id: str) -> str:
+        return hashlib.sha256(
+            document_id.encode("utf-8")).hexdigest() + ".json"
+
     def _persist(self, document_id: str, entry: dict) -> None:
-        path = os.path.join(self.root, f"{document_id}.json")
+        path = os.path.join(self.root, self._filename(document_id))
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(dict(entry, summary=encode_contents(
-                entry["summary"])), f)
+            json.dump(dict(entry, document_id=document_id,
+                           summary=encode_contents(entry["summary"])), f)
         os.replace(tmp, path)
 
 
@@ -195,6 +212,8 @@ class _DocumentFacade:
         if self.auth_error is not None:
             raise PermissionError(
                 f"connect_document rejected: {self.auth_error}")
+        if self._client._closed:
+            raise ConnectionError("connection closed during handshake")
         return SocketDeltaConnection(self, client_id)
 
     # SocketDeltaConnection needs _send + document_id
@@ -263,6 +282,11 @@ class MultiplexedSocketClient(SocketDocumentService):
         facade = self._facades.get(frame.get("document_id", ""))
         if facade is not None:
             facade.auth_error = frame.get("message", "rejected")
+            facade._connected.set()
+
+    def _on_transport_closed(self) -> None:
+        super()._on_transport_closed()
+        for facade in list(self._facades.values()):
             facade._connected.set()
 
     def _deliver(self, frame: dict) -> None:

@@ -139,8 +139,17 @@ class SocketDocumentService:
                         slot.append(frame)
                         event.set()
                     continue
-                if frame.get("type") == "connected":
+                kind = frame.get("type")
+                if kind == "connected":
                     self._on_connected(frame)
+                elif kind == "connect_document_error":
+                    # deliver directly from the pump: the dispatcher
+                    # takes self.lock before delivering, but callers
+                    # hold that lock around Container.load while
+                    # waiting on _connected — routing the rejection
+                    # through the dispatcher would deadlock into a
+                    # TimeoutError instead of a prompt PermissionError
+                    self._on_connect_error(frame)
                 else:
                     self._inbox.put(frame)
         finally:
@@ -153,6 +162,13 @@ class SocketDocumentService:
                 self._pending.clear()
             for event, _slot in waiters:
                 event.set()
+            # a thread blocked in the connect_document handshake must
+            # fail promptly too (socket death mid-handshake otherwise
+            # waits out the full timeout)
+            self._on_transport_closed()
+
+    def _on_transport_closed(self) -> None:
+        self._connected.set()
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -175,9 +191,6 @@ class SocketDocumentService:
 
     def _deliver(self, frame: dict) -> None:
         kind = frame.get("type")
-        if kind == "connect_document_error":
-            self._on_connect_error(frame)
-            return
         if kind == "error":
             # a submit the server could neither sequence nor nack
             # (e.g. undecodable op contents): losing it silently would
@@ -247,6 +260,8 @@ class SocketDocumentService:
         if self.auth_error is not None:
             raise PermissionError(
                 f"connect_document rejected: {self.auth_error}")
+        if self._closed:
+            raise ConnectionError("connection closed during handshake")
         return SocketDeltaConnection(self, client_id)
 
     def read_ops(self, from_seq: int,

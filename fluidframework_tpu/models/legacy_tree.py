@@ -322,6 +322,10 @@ def invert_edit(changes: list, repair: dict) -> list:
         if t == "insert":
             entry = next(ins_iter)
             inserted, origin = entry["ids"], entry["origin"]
+            if not inserted:
+                # insert consumed an empty detached sequence (move or
+                # build of zero nodes): nothing to undo
+                continue
             rng = range_of(place_before(inserted[0]),
                            place_after(inserted[-1]))
             if origin is None:

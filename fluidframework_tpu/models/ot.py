@@ -92,6 +92,11 @@ class SharedOT(SharedObject, EventEmitter):
         self._global = self.apply_core(self._global, op)
         if local and self._pending:
             self._pending.pop(0)
+        else:
+            # transform the pending local queue over the remote op so the
+            # optimistic view replays against the shifted global state
+            # (ot.ts:125-127 pendingOps[i] = transform(pendingOps[i], op))
+            self._pending = [self.transform(p, op) for p in self._pending]
         self._dirty = True
         self.emit("op", local)
 

@@ -112,6 +112,10 @@ class TenantManager:
             if not hmac.compare_digest(expect, _unb64(sig)):
                 raise AuthError("bad signature")
             claims = json.loads(_unb64(payload))
+            if not isinstance(claims, dict):
+                # a signed non-object payload is malformed, not a
+                # server error: claims.get below must never AttributeError
+                raise AuthError("malformed token: claims not an object")
         except AuthError:
             raise
         except Exception as e:  # malformed token shape

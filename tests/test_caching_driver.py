@@ -117,6 +117,23 @@ def test_file_cache_survives_restart(tmp_path):
     assert entry["summary"] == {"blob": [1, 2, 3]}
 
 
+def test_file_cache_hostile_document_id_stays_in_root(tmp_path):
+    # ids with path separators / '..' must hash to a filename inside
+    # the cache root and still reload after restart
+    import os
+    root = tmp_path / "cache"
+    evil = "../../escape/../doc/with/slashes"
+    c1 = FileSnapshotCache(str(root))
+    c1.put(evil, 7, {"v": 1})
+    # nothing written outside the cache root
+    names = os.listdir(root)
+    assert len(names) == 1 and names[0].endswith(".json")
+    assert not (tmp_path / "escape").exists()
+    c2 = FileSnapshotCache(str(root))
+    entry = c2.get(evil)
+    assert entry is not None and entry["sequence_number"] == 7
+
+
 # ---- multiplexing -----------------------------------------------------
 
 def test_two_documents_one_socket(server):

@@ -245,6 +245,24 @@ def test_revert_move_moves_back():
     assert a.view.nodes["n1"]["payload"] == "keep"
 
 
+def test_revert_move_of_empty_range_is_noop():
+    """Regression: an APPLIED move of an EMPTY range produced an
+    insert repair entry with ids=[]; revert used to IndexError on
+    inserted[0] instead of emitting a no-op inverse."""
+    s, (a, b) = make_session()
+    a.apply(insert_tree([leaf("n1")], place_at_start("root", "items")))
+    s.process_all()
+    # empty range: before-n1 .. before-n1 selects zero nodes
+    mid = a.apply(move(range_of(place_before("n1"), place_before("n1")),
+                       place_at_end("root", "archive")))
+    s.process_all()
+    assert a.edit_log[-1]["status"] == APPLIED
+    a.revert(mid)          # must not raise
+    s.process_all()
+    assert a.signature() == b.signature()
+    assert kids_of(a) == ["n1"]
+
+
 def test_revert_ids_do_not_collide_across_clients():
     """Regression: repair data is keyed by global seq; two clients'
     edit #N must not collide (revert used to invert the wrong edit)."""

@@ -87,6 +87,37 @@ def test_concurrent_deletes_same_element():
     assert sig == {"items": ["y"]}
 
 
+def test_pending_local_op_transformed_over_remote_delete():
+    # ot.ts:125-127 — the pending queue must be transformed over each
+    # incoming remote op; otherwise the optimistic view replays the
+    # pending op at a stale index (IndexError / wrong element here)
+    s, (a, b) = make_session()
+    a.set(["items"], ["x", "y", "z"])
+    s.process_all()
+    a.list_delete(["items"], 0)
+    s.flush("A")
+    b.set(["items", 2], "Z")           # still pending on B
+    s.process_some(1)                  # deliver A's delete to B
+    assert b.state == {"items": ["y", "Z"]}
+    sig = converged(s, [a, b])
+    assert sig == {"items": ["y", "Z"]}
+
+
+def test_pending_local_op_dropped_when_remote_removes_subtree():
+    # a pending edit under a subtree a remote od removed must not
+    # poison the optimistic view (KeyError in _descend pre-fix)
+    s, (a, b) = make_session()
+    a.set(["cfg"], {"x": 1})
+    s.process_all()
+    a.remove(["cfg"])
+    s.flush("A")
+    b.set(["cfg", "x"], 2)             # pending, targets dead subtree
+    s.process_some(1)
+    assert b.state == {}               # no crash, edit dropped
+    sig = converged(s, [a, b])
+    assert sig == {}
+
+
 def test_delete_shifts_later_indices():
     s, (a, b) = make_session()
     a.set(["items"], ["x", "y", "z"])

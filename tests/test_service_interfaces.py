@@ -82,6 +82,26 @@ def test_token_rejections():
         tm.validate_token(ro, "acme", "doc1", SCOPE_WRITE)
 
 
+def test_signed_non_object_claims_is_auth_error():
+    # a valid-signature token whose claims JSON is a list/scalar must
+    # map to AuthError (not AttributeError → generic server error)
+    import base64
+    import hashlib as _hl
+    import hmac as _hm
+    import json as _json
+
+    tm = TenantManager()
+    t = tm.create_tenant("acme")
+    for bad_claims in ([1, 2, 3], "just-a-string", 42):
+        payload = base64.urlsafe_b64encode(
+            _json.dumps(bad_claims).encode()).rstrip(b"=").decode()
+        sig = _hm.new(t.key.encode(), payload.encode(),
+                      _hl.sha256).digest()
+        sig_s = base64.urlsafe_b64encode(sig).rstrip(b"=").decode()
+        with pytest.raises(AuthError, match="malformed"):
+            tm.validate_token(f"{payload}.{sig_s}", "acme", "doc1")
+
+
 def test_disabled_tenant_rejected():
     tm = TenantManager()
     t = tm.create_tenant("acme")
@@ -216,6 +236,35 @@ def test_read_mode_connection_cannot_write_and_does_not_pin_msn():
         ro.submit(DocumentMessage(
             client_sequence_number=1, reference_sequence_number=0,
             type=MessageType.OPERATION, contents={}))
+
+
+def test_connect_rejection_prompt_while_holding_service_lock(
+        alfred_on_thread):
+    """Regression: the documented usage holds svc.lock around
+    Container.load; connect_document_error used to route through the
+    dispatcher (which needs that lock), so an auth rejection surfaced
+    as a full-timeout TimeoutError instead of a prompt
+    PermissionError."""
+    import time as _time
+
+    from fluidframework_tpu.drivers.socket_driver import (
+        SocketDocumentService,
+    )
+
+    tm = TenantManager()
+    tm.create_tenant("acme")
+    server = alfred_on_thread(tenants=tm)
+    svc = SocketDocumentService(
+        "127.0.0.1", server.port, "d", timeout=10.0,
+        tenant_id="acme", token="bogus.token")
+    try:
+        with svc.lock:        # what Container.load does
+            t0 = _time.monotonic()
+            with pytest.raises(PermissionError, match="rejected"):
+                svc.connect_to_delta_stream("alice", lambda m: None)
+            assert _time.monotonic() - t0 < 5.0  # prompt, not timeout
+    finally:
+        svc.close()
 
 
 def test_storage_planes_require_auth():
