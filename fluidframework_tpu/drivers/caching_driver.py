@@ -204,6 +204,8 @@ class _DocumentFacade:
         # see the previous attempt's rejection or completion state
         self.auth_error = None
         self._connected.clear()
+        if self._client._closed:
+            raise ConnectionError("connection closed")
         self._client._send(build_connect_frame(
             self.document_id, client_id, self.mode,
             self.tenant_id, self.token))

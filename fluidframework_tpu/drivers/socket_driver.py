@@ -252,6 +252,10 @@ class SocketDocumentService:
         # rejection or completion state
         self.auth_error = None
         self._connected.clear()
+        if self._closed:
+            # transport already dead: clear() above just discarded the
+            # shutdown wakeup — fail now, not after the full timeout
+            raise ConnectionError("connection closed")
         self._send(build_connect_frame(
             self.document_id, client_id, self.mode,
             self.tenant_id, self.token))

@@ -95,21 +95,33 @@ class MatrixStream:
                 + len(self.cell_rows))
 
 
-def apply_matrix_batch(streams: list[MatrixStream],
-                       capacity: int = 1024) -> SegmentTable:
-    """Apply every matrix's two axis streams in ONE merge-kernel
-    dispatch: 2N-doc table, even slots rows, odd slots cols."""
-    from .segment_table import make_table
-
+def pack_matrix_batch(streams: list[MatrixStream]):
+    """Pack every matrix's two axis streams into one OpBatch: even
+    doc slots = row axes, odd = col axes (the single definition of the
+    slot-layout convention)."""
     axis_streams: list[DocStream] = []
     for ms in streams:
         axis_streams.append(ms.rows)
         axis_streams.append(ms.cols)
-    batch = build_batch(axis_streams)
-    table = apply_window(
-        make_table(2 * len(streams), capacity), batch
+    return build_batch(axis_streams)
+
+
+def dispatch_matrix_batch(batch, n_matrices: int,
+                          capacity: int = 1024) -> SegmentTable:
+    """ONE merge-kernel dispatch over a packed 2N-doc axis batch."""
+    from .segment_table import make_table
+
+    return apply_window(make_table(2 * n_matrices, capacity), batch)
+
+
+def apply_matrix_batch(streams: list[MatrixStream],
+                       capacity: int = 1024) -> SegmentTable:
+    """Pack + dispatch in one call (pack separately via
+    ``pack_matrix_batch`` when the pack cost must stay off the timed
+    path)."""
+    return dispatch_matrix_batch(
+        pack_matrix_batch(streams), len(streams), capacity
     )
-    return table
 
 
 def _visible_handles(table_np: dict, doc: int,

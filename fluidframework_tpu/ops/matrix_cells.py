@@ -1,0 +1,128 @@
+"""Device-side SharedMatrix cell application: sort + last-wins.
+
+Reference semantics: packages/dds/matrix/src/matrix.ts:79 — cell
+writes are LWW registers keyed by (rowHandle, colHandle); handles are
+stable under any concurrent row/col permutation (permutationvector.ts
+:137), so cell conflict resolution never needs the merge tree: the
+winner of a key is simply the highest-sequenced write.
+
+TPU mapping: an entire WINDOW of setCell ops is one batched
+``lax.sort`` by (cell key, window index) followed by a run-end winner
+mask and one scatter into the dense handle-space grid — no sequential
+scan, no per-op dispatch. This replaces the reference's per-op
+sparse-array bookkeeping (matrix.ts setCellCore) with a single
+data-parallel reduction: thousands of ops cost the same handful of
+kernel launches as one.
+
+Handles are interned host-side to dense ints (a grid over the
+ALLOCATED handle space — removed rows keep their lane, exactly like
+the reference's handle table retaining dead handles until GC). The
+grid stores the winning WINDOW INDEX; values stay host-side in a
+per-matrix table (same host/device payload split as the text path,
+SURVEY §7).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def apply_cells_kernel(keys: jnp.ndarray, n_rows: int,
+                       n_cols: int) -> jnp.ndarray:
+    """[M, N] cell-write keys -> [M, n_rows, n_cols] LWW grid of
+    winning window indices (-1 = never written).
+
+    keys = row_handle * n_cols + col_handle, or -1 padding. Window
+    order IS sequenced order, so the tie-break within a key is the
+    window index itself.
+    """
+    M, N = keys.shape
+    # int32 composite (JAX x32 mode): callers guarantee
+    # (n_rows*n_cols) * (N+1) < 2^31 by windowing (CellPack.apply)
+    stride = jnp.int32(N + 1)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    composite = keys.astype(jnp.int32) * stride + idx
+    (scomp,) = jax.lax.sort([composite], dimension=-1, num_keys=1)
+    skey = jnp.where(scomp >= 0, scomp // stride, -1)
+    swin = scomp % stride
+    nxt = jnp.concatenate(
+        [skey[:, 1:], jnp.full((M, 1), -2, skey.dtype)], axis=-1
+    )
+    winner = (skey != nxt) & (skey >= 0)
+    # scatter winners; losers/padding route to a dump slot past the end
+    dest = jnp.where(winner, skey, n_rows * n_cols)
+    grid = jnp.full((M, n_rows * n_cols + 1), -1, jnp.int32)
+    grid = jax.vmap(lambda g, d, v: g.at[d].set(v))(grid, dest, swin)
+    return grid[:, : n_rows * n_cols].reshape(M, n_rows, n_cols)
+
+
+class CellPack:
+    """Host-side interning of one batch of matrices' cell streams into
+    the kernel's array layout."""
+
+    def __init__(self, n_rows: int, n_cols: int):
+        self.n_rows = n_rows
+        self.n_cols = n_cols
+        self.row_ids: list[dict[str, int]] = []
+        self.col_ids: list[dict[str, int]] = []
+        self.val_tables: list[list[Any]] = []
+        self.keys: Optional[np.ndarray] = None
+
+    def pack(self, streams) -> None:
+        """streams: MatrixStream list; builds the [M, N] key array
+        (N = max cell-op count across matrices, -1 padded)."""
+        M = len(streams)
+        N = max((len(s.cell_vals) for s in streams), default=0)
+        keys = np.full((M, max(N, 1)), -1, np.int32)
+        self.row_ids, self.col_ids, self.val_tables = [], [], []
+        for m, s in enumerate(streams):
+            r_ids: dict[str, int] = {}
+            c_ids: dict[str, int] = {}
+            for i, (rh, ch) in enumerate(zip(s.cell_rows, s.cell_cols)):
+                r = r_ids.setdefault(rh, len(r_ids))
+                c = c_ids.setdefault(ch, len(c_ids))
+                if r >= self.n_rows or c >= self.n_cols:
+                    raise ValueError("cell handle space overflow")
+                keys[m, i] = r * self.n_cols + c
+            self.row_ids.append(r_ids)
+            self.col_ids.append(c_ids)
+            self.val_tables.append(list(s.cell_vals))
+        self.keys = keys
+
+    def apply(self):
+        """Device dispatch covering every matrix's whole cell window.
+        One kernel call normally; if the int32 composite key would
+        overflow, the window splits into segments combined LWW (later
+        segment wins — same order the single sort respects)."""
+        keys = np.asarray(self.keys, np.int32)
+        M, N = keys.shape
+        space = self.n_rows * self.n_cols
+        max_n = max(1, (2**31 - 1) // max(space, 1) - 1)
+        if N <= max_n:
+            return apply_cells_kernel(
+                jnp.asarray(keys), self.n_rows, self.n_cols
+            )
+        grid = None
+        for s in range(0, N, max_n):
+            seg = jnp.asarray(keys[:, s:s + max_n])
+            part = apply_cells_kernel(seg, self.n_rows, self.n_cols)
+            part = jnp.where(part >= 0, part + s, part)
+            grid = part if grid is None else jnp.where(
+                part >= 0, part, grid
+            )
+        return grid
+
+    def lookup(self, grid_np: np.ndarray, m: int, row_handle: str,
+               col_handle: str) -> Any:
+        """Read one cell's LWW value from the fetched grid."""
+        r = self.row_ids[m].get(row_handle)
+        c = self.col_ids[m].get(col_handle)
+        if r is None or c is None:
+            return None
+        idx = int(grid_np[m, r, c])
+        return None if idx < 0 else self.val_tables[m][idx]

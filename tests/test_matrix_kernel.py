@@ -167,3 +167,104 @@ def test_matrix_kernel_reconnect_resubmit_handles():
     b.flush()
     assert ma.to_lists() == mb.to_lists()
     assert replay_kernel(server) == ma.to_lists()
+
+
+# ---- device cell path: sort + last-wins (matrix.ts:79 LWW) -----------
+
+def _host_lww(streams):
+    """Scalar LWW oracle: dict keyed by (row, col), window order."""
+    out = []
+    for s in streams:
+        d = {}
+        for rh, ch, v in zip(s.cell_rows, s.cell_cols, s.cell_vals):
+            d[(rh, ch)] = v
+        out.append(d)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_cell_kernel_matches_host_lww(seed):
+    import numpy as np
+
+    from fluidframework_tpu.ops.matrix_cells import CellPack
+
+    rng = random.Random(seed)
+    streams = []
+    for m in range(3):
+        s = MatrixStream()
+        n = rng.randint(0, 120)
+        for _ in range(n):
+            s.cell_rows.append(f"r{rng.randint(0, 15)}")
+            s.cell_cols.append(f"c{rng.randint(0, 5)}")
+            s.cell_vals.append(rng.randint(0, 10**6))
+        streams.append(s)
+    pack = CellPack(n_rows=16, n_cols=6)
+    pack.pack(streams)
+    grid = np.asarray(pack.apply())
+    oracle = _host_lww(streams)
+    for m, s in enumerate(streams):
+        for (rh, ch), want in oracle[m].items():
+            assert pack.lookup(grid, m, rh, ch) == want, (seed, m, rh, ch)
+        # unwritten cells read None
+        assert pack.lookup(grid, m, "r-none", "c0") is None
+    # every grid entry that holds an index must be a winner
+    for m in range(len(streams)):
+        for r_h, r in pack.row_ids[m].items():
+            for c_h, c in pack.col_ids[m].items():
+                got = pack.lookup(grid, m, r_h, c_h)
+                assert got == oracle[m].get((r_h, c_h))
+
+
+def test_cell_kernel_empty_and_single():
+    import numpy as np
+
+    from fluidframework_tpu.ops.matrix_cells import CellPack
+
+    empty = MatrixStream()
+    one = MatrixStream()
+    one.cell_rows.append("a:0")
+    one.cell_cols.append("b:0")
+    one.cell_vals.append("v")
+    pack = CellPack(n_rows=4, n_cols=4)
+    pack.pack([empty, one])
+    grid = np.asarray(pack.apply())
+    assert pack.lookup(grid, 0, "a:0", "b:0") is None
+    assert pack.lookup(grid, 1, "a:0", "b:0") == "v"
+
+
+def test_cell_kernel_window_segmentation():
+    """Composite-key overflow splits the window into LWW-combined
+    segments; the result must equal the unsplit ordering."""
+    import numpy as np
+
+    from fluidframework_tpu.ops.matrix_cells import CellPack
+
+    rng = random.Random(7)
+    s = MatrixStream()
+    for _ in range(50):
+        s.cell_rows.append(f"r{rng.randint(0, 3)}")
+        s.cell_cols.append(f"c{rng.randint(0, 3)}")
+        s.cell_vals.append(rng.randint(0, 999))
+    # tiny grid but force segmentation by monkeypatching the threshold
+    pack = CellPack(n_rows=4, n_cols=4)
+    pack.pack([s])
+    full = np.asarray(pack.apply())
+
+    import fluidframework_tpu.ops.matrix_cells as mc
+
+    # shrink the per-segment budget to force 5-op segments
+    orig = mc.apply_cells_kernel
+    pack2 = CellPack(n_rows=4, n_cols=4)
+    pack2.pack([s])
+    keys = np.asarray(pack2.keys, np.int32)
+    grid = None
+    import jax.numpy as jnp
+    for seg_start in range(0, keys.shape[1], 5):
+        seg = jnp.asarray(keys[:, seg_start:seg_start + 5])
+        part = orig(seg, 4, 4)
+        part = jnp.where(part >= 0, part + seg_start, part)
+        grid = part if grid is None else jnp.where(part >= 0, part, grid)
+    assert np.array_equal(full, np.asarray(grid))
+    oracle = _host_lww([s])[0]
+    for (rh, ch), want in oracle.items():
+        assert pack.lookup(full, 0, rh, ch) == want
