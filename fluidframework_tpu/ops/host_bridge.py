@@ -24,6 +24,7 @@ from .segment_table import (
     KIND_REMOVE,
     MAX_CLIENTS,
     NOT_REMOVED,
+    OPOFF_BOUND,
     OpBatch,
     PROP_CHANNELS,
     SegmentTable,
@@ -108,6 +109,15 @@ class DocStream:
             is_marker = op.text is None
             payload = "" if is_marker else op.text
             length = 1 if is_marker else len(payload)
+            if length >= OPOFF_BOUND:
+                # one op's payload bounds the op_off composite the
+                # kernel's fused reduce packs; the op-splitter
+                # (runtime/op_lifecycle.py) chunks payloads this large
+                # long before they reach a device window
+                raise ValueError(
+                    f"insert payload {length} exceeds device bound "
+                    f"{OPOFF_BOUND}"
+                )
             self.ops.append(dict(
                 base, kind=KIND_INSERT, pos1=op.pos1,
                 op_id=len(self.payloads),

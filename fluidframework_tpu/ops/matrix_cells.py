@@ -94,15 +94,17 @@ class CellPack:
             self.val_tables.append(list(s.cell_vals))
         self.keys = keys
 
-    def apply(self):
+    def apply(self, budget: int = 2**31 - 1):
         """Device dispatch covering every matrix's whole cell window.
         One kernel call normally; if the int32 composite key would
-        overflow, the window splits into segments combined LWW (later
-        segment wins — same order the single sort respects)."""
+        overflow ``budget``, the window splits into segments combined
+        LWW (later segment wins — same order the single sort
+        respects). ``budget`` exists so tests can force the
+        segmentation branch at small sizes."""
         keys = np.asarray(self.keys, np.int32)
         M, N = keys.shape
         space = self.n_rows * self.n_cols
-        max_n = max(1, (2**31 - 1) // max(space, 1) - 1)
+        max_n = max(1, budget // max(space, 1) - 1)
         if N <= max_n:
             return apply_cells_kernel(
                 jnp.asarray(keys), self.n_rows, self.n_cols

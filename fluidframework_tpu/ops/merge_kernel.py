@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from .merge_step import (
+    OPOFF_BOUND,
     batch_to_window,
     fused_step,
     state_to_table,
@@ -63,12 +64,18 @@ def apply_window_impl(table: SegmentTable, batch: OpBatch) -> SegmentTable:
     """XLA executor: scan the fused step over the [docs, window] batch.
     Pure/jittable; doc axis shards cleanly under shard_map.
 
+    Capacity bound: the phase-1 op_off composite (j*OPOFF_BOUND +
+    op_off) must fit int32 (merge_step.OPOFF_BOUND).
+
     unroll=4 on TPU: the axon runtime charges ~0.3ms per kernel
     launch, so per-step launch overhead dominates the window (measured
     2.35 -> 1.52 ms/step at 1024x512; unroll 16 was marginally faster
     at 1.35 but ballooned remote compiles past the bench timeout).
     Kept at 1 elsewhere — CPU tests would only pay extra compile.
     """
+    assert table.capacity * OPOFF_BOUND < 2**31, (
+        f"capacity {table.capacity} overflows the op_off composite"
+    )
     st = table_to_state(table)
     ops_wd = batch_to_window(batch)
 

@@ -43,6 +43,7 @@ from .segment_table import (
     KIND_INSERT,
     KIND_REMOVE,
     NOT_REMOVED,
+    OPOFF_BOUND as _OPOFF_BOUND,
     PROP_CHANNELS,
 )
 
@@ -143,6 +144,27 @@ def _at(arr, idx, j):
     )
 
 
+def _min_where(mask, arr, default):
+    """min of ``arr`` over ``mask`` along the last axis ([D,1]).
+
+    For a monotone non-decreasing ``arr`` this equals
+    ``arr[first_true(mask)]`` — the trick that collapses the step's
+    second (index-dependent gather) reduce layer into the first: E and
+    incl are prefix sums, so every "value at the first masked slot"
+    lookup is a plain masked min, and all of phase 1 becomes ONE
+    fusable reduce layer instead of two dependent ones (each layer is
+    a separate kernel launch, and the axon environment charges ~0.3ms
+    per launch — TPU_EVIDENCE.md)."""
+    return jnp.min(
+        jnp.where(mask, arr, default), axis=-1, keepdims=True
+    )
+
+
+# re-exported for executors; defined host-side in segment_table so the
+# pure-numpy encoding path never imports the jax stack
+OPOFF_BOUND = _OPOFF_BOUND
+
+
 class AxisPrims:
     """The segment-axis primitives ``fused_step`` is generic over.
 
@@ -157,7 +179,7 @@ class AxisPrims:
 
     def __init__(self, *, iota_j=None, excl_cumsum=None, shift_right=None,
                  shift_right_many=None, first_true=None, at=None,
-                 total=None, global_capacity=None):
+                 min_where=None, total=None, global_capacity=None):
         self.iota_j = iota_j or (
             lambda D, C: lax.broadcasted_iota(jnp.int32, (D, C), 1))
         self.excl_cumsum = excl_cumsum or _excl_cumsum_native
@@ -169,6 +191,7 @@ class AxisPrims:
             lambda arrs, k: [self.shift_right(a, k) for a in arrs])
         self.first_true = first_true or _first_true
         self.at = at or _at
+        self.min_where = min_where or _min_where
         # global visible-length total [D,1]; default = last inclusive
         # prefix (exact integer sum, == jnp.sum(vlen))
         self.total = total or (lambda vlen, incl: incl[..., -1:])
@@ -199,7 +222,7 @@ def fused_step(st: dict, op: dict,
     AxisPrims implementation is the only knob, and every variant
     produces exact integer sums)."""
     _first_true = prims.first_true
-    _at = prims.at
+    _min_where = prims.min_where
     C = st["length"].shape[-1]
     D = st["length"].shape[0]
     Cg = prims.global_capacity(C)
@@ -232,13 +255,26 @@ def fused_step(st: dict, op: dict,
     incl = E + vlen
     total = prims.total(vlen, incl)
 
+    # All "value at the first masked slot" lookups below ride the SAME
+    # single reduce layer as the index searches: E and incl are
+    # monotone non-decreasing (prefix sums), so value-at-first-true ==
+    # masked min; op_off rides a j*OPOFF_BOUND+op_off composite whose
+    # min is the first masked j's entry. One fused reduce layer
+    # replaces the previous two dependent layers (VERDICT r4 perf).
+    BIG = jnp.int32(2**31 - 1)
+    opoff_comp = j * OPOFF_BOUND + st["op_off"]
+
     # INSERT target: first stop slot with E==p1, or p1 strictly inside
     # (breakTie on the sequenced path: insert before the first
     # stop-eligible slot at the boundary — mergeTree.ts:1705)
     inside = stop & (E <= p1) & (p1 < incl)
     target = inside | (stop & (E == p1))
     idx_t = _first_true(target, j, count)
-    off_ins = jnp.where(idx_t < count, p1 - _at(E, idx_t, j), 0)
+    E_t = _min_where(target, E, BIG)
+    incl_t = _min_where(target, incl, BIG)
+    opoff_t = _min_where(target, opoff_comp, BIG) % OPOFF_BOUND
+    found_t = idx_t < count
+    off_ins = jnp.where(found_t, p1 - E_t, 0)
 
     # RANGE boundary splits, both resolved on the PRE-op view; the p2
     # event is shifted into post-split-1 coordinates below (splitting
@@ -247,11 +283,17 @@ def fused_step(st: dict, op: dict,
     strict1 = (E < p1) & (p1 < incl)
     idx1 = _first_true(strict1, j, Cg)
     s1 = idx1 < Cg
-    off1 = p1 - _at(E, idx1, j)
+    E_1 = _min_where(strict1, E, BIG)
+    incl_1 = _min_where(strict1, incl, BIG)
+    opoff_1 = _min_where(strict1, opoff_comp, BIG) % OPOFF_BOUND
+    off1 = jnp.where(s1, p1 - E_1, 0)
     strict2 = (E < p2) & (p2 < incl)
     idx2 = _first_true(strict2, j, Cg)
     s2 = idx2 < Cg
-    off2 = p2 - _at(E, idx2, j)
+    E_2 = _min_where(strict2, E, BIG)
+    incl_2 = _min_where(strict2, incl, BIG)
+    opoff_2 = _min_where(strict2, opoff_comp, BIG) % OPOFF_BOUND
+    off2 = jnp.where(s2, p2 - E_2, 0)
     same = s1 & s2 & (idx1 == idx2)
 
     # ---- phase 2: unified two-insertion restructure ------------------
@@ -303,11 +345,13 @@ def fused_step(st: dict, op: dict,
     at_B = u2 & (j == B)
     new_at_A = at_A & is_ins
 
-    # gathers from the pre-op layout (masked reduces)
-    len_k1 = _at(st["length"], k1, j)
-    len_k2 = _at(st["length"], idx2, j)
-    opoff_k1 = _at(st["op_off"], k1, j)
-    opoff_k2 = _at(st["op_off"], idx2, j)
+    # values at the split slots, all recovered from the single phase-1
+    # reduce layer: incl-E == vlen == length there (every split slot is
+    # visible: 'inside' and strictN imply E < incl, i.e. vlen > 0)
+    len_k1 = jnp.where(is_ins, incl_t - E_t, incl_1 - E_1)
+    len_k2 = incl_2 - E_2
+    opoff_k1 = jnp.where(is_ins, opoff_t, opoff_1)
+    opoff_k2 = opoff_2
 
     f_h1 = ~skip & (split_ins | (is_range & s1)) & (j == k1)
     f_h2 = ~skip & is_range & s2 & (j == h2)

@@ -26,6 +26,14 @@ import numpy as np
 # "never removed" sentinel: all real seqs compare below it.
 NOT_REMOVED = np.int32(2**31 - 1)
 
+# Per-op payload bound: the merge step packs op_off into a
+# j*OPOFF_BOUND+op_off int32 composite so "op_off at the first masked
+# slot" rides the same single min-reduce layer as the index searches
+# (merge_step.fused_step). Host encoding rejects larger payloads
+# (host_bridge._add_op — the op-splitter chunks them first) and every
+# executor asserts global_capacity * OPOFF_BOUND fits int32.
+OPOFF_BOUND = 1 << 17
+
 # Fixed number of interned property channels per document.
 PROP_CHANNELS = 4
 

@@ -125,6 +125,15 @@ def seq_prims(axis: str = SEQ_AXIS) -> AxisPrims:
                       keepdims=True)
         return lax.psum(loc, axis)
 
+    def min_where(mask, arr, default):
+        # masked min is shard-local then pmin — the collective form of
+        # "value at the first masked slot" for monotone arrays (the
+        # cross-shard monotonicity holds because excl_cumsum above adds
+        # each shard's global offset)
+        loc = jnp.min(jnp.where(mask, arr, default), axis=-1,
+                      keepdims=True)
+        return lax.pmin(loc, axis)
+
     def total(vlen, incl):
         return lax.psum(
             jnp.sum(vlen, axis=-1, keepdims=True), axis
@@ -136,7 +145,7 @@ def seq_prims(axis: str = SEQ_AXIS) -> AxisPrims:
     return AxisPrims(
         iota_j=iota_j, excl_cumsum=excl_cumsum, shift_right=shift_right,
         shift_right_many=shift_right_many,
-        first_true=first_true, at=at, total=total,
+        first_true=first_true, at=at, min_where=min_where, total=total,
         global_capacity=global_capacity,
     )
 
@@ -208,6 +217,16 @@ def apply_window_seq_sharded(
             f"seq shard width {table.capacity // n_seq} < 2: the "
             f"two-slot restructure shift would cross more than one "
             f"shard boundary"
+        )
+    # iota_j is GLOBAL under seq sharding, so the op_off composite in
+    # fused_step spans global_capacity * OPOFF_BOUND — it must fit
+    # int32 or the masked min silently picks wrapped-negative entries
+    from ..ops.segment_table import OPOFF_BOUND
+
+    if table.capacity * OPOFF_BOUND >= 2**31:
+        raise ValueError(
+            f"global capacity {table.capacity} overflows the op_off "
+            f"composite (max {(2**31 - 1) // OPOFF_BOUND})"
         )
 
     st = table_to_state(table)

@@ -234,7 +234,9 @@ def test_cell_kernel_empty_and_single():
 
 def test_cell_kernel_window_segmentation():
     """Composite-key overflow splits the window into LWW-combined
-    segments; the result must equal the unsplit ordering."""
+    segments — exercised through the PRODUCTION CellPack.apply branch
+    by shrinking the int32 budget — and must equal the single-kernel
+    result."""
     import numpy as np
 
     from fluidframework_tpu.ops.matrix_cells import CellPack
@@ -245,26 +247,13 @@ def test_cell_kernel_window_segmentation():
         s.cell_rows.append(f"r{rng.randint(0, 3)}")
         s.cell_cols.append(f"c{rng.randint(0, 3)}")
         s.cell_vals.append(rng.randint(0, 999))
-    # tiny grid but force segmentation by monkeypatching the threshold
     pack = CellPack(n_rows=4, n_cols=4)
     pack.pack([s])
-    full = np.asarray(pack.apply())
-
-    import fluidframework_tpu.ops.matrix_cells as mc
-
-    # shrink the per-segment budget to force 5-op segments
-    orig = mc.apply_cells_kernel
-    pack2 = CellPack(n_rows=4, n_cols=4)
-    pack2.pack([s])
-    keys = np.asarray(pack2.keys, np.int32)
-    grid = None
-    import jax.numpy as jnp
-    for seg_start in range(0, keys.shape[1], 5):
-        seg = jnp.asarray(keys[:, seg_start:seg_start + 5])
-        part = orig(seg, 4, 4)
-        part = jnp.where(part >= 0, part + seg_start, part)
-        grid = part if grid is None else jnp.where(part >= 0, part, grid)
-    assert np.array_equal(full, np.asarray(grid))
+    full = np.asarray(pack.apply())            # single-kernel path
+    # budget 16*6 => max_n = 5 => ten ~5-op segments, real branch
+    seg_grid = np.asarray(pack.apply(budget=4 * 4 * 6))
+    assert np.array_equal(full, seg_grid)
     oracle = _host_lww([s])[0]
     for (rh, ch), want in oracle.items():
         assert pack.lookup(full, 0, rh, ch) == want
+        assert pack.lookup(seg_grid, 0, rh, ch) == want

@@ -1,0 +1,276 @@
+"""Chunked executor vs sequential executor: differential bit-equality
+of the live slot state on identical fuzzed op windows.
+
+This is the semantics gate for ops/merge_chunk.py — the sequential
+scan (itself differential-fuzzed against the scalar oracle and the C++
+replayer) is the ground truth; the chunked path must reproduce its
+live rows bit-for-bit (garbage rows beyond `count` may differ: the
+sort-based restructure parks different garbage than the shift-based
+one)."""
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops import build_batch, encode_stream, make_table
+from fluidframework_tpu.ops.merge_chunk import (
+    apply_window_chunked,
+    build_chunked,
+    compile_chunks,
+)
+from fluidframework_tpu.ops.merge_kernel import apply_window_impl
+from fluidframework_tpu.ops.segment_table import (
+    KIND_INSERT,
+    KIND_NOOP,
+    KIND_REMOVE,
+    NOT_REMOVED,
+    OpBatch,
+)
+from fluidframework_tpu.testing import FuzzConfig, record_op_stream
+
+LIVE_FIELDS = (
+    "length", "seq", "client", "removed_seq", "removers",
+    "op_id", "op_off", "is_marker",
+)
+
+
+def assert_live_equal(seq_tab, chunk_tab, ctx=""):
+    ns, nc = {}, {}
+    for f in seq_tab._fields:
+        ns[f] = np.asarray(getattr(seq_tab, f))
+        nc[f] = np.asarray(getattr(chunk_tab, f))
+    assert np.array_equal(ns["count"], nc["count"]), (
+        f"{ctx}: count {ns['count']} vs {nc['count']}"
+    )
+    assert np.array_equal(ns["min_seq"], nc["min_seq"]), ctx
+    assert np.array_equal(ns["overflow"], nc["overflow"]), ctx
+    D = ns["count"].shape[0]
+    for d in range(D):
+        if ns["overflow"][d]:
+            continue  # post-overflow application intentionally differs
+        n = int(ns["count"][d])
+        for f in LIVE_FIELDS:
+            assert np.array_equal(ns[f][d, :n], nc[f][d, :n]), (
+                f"{ctx}: doc {d} field {f}\n"
+                f"seq:   {ns[f][d, :n]}\n"
+                f"chunk: {nc[f][d, :n]}"
+            )
+        assert np.array_equal(
+            ns["prop"][d, :n], nc["prop"][d, :n]
+        ), f"{ctx}: doc {d} props"
+
+
+def run_both(streams, capacity=256, K=8):
+    batch = build_batch([encode_stream(s) for s in streams])
+    D = len(streams)
+    seq_tab = apply_window_impl(make_table(D, capacity), batch)
+    chunked = build_chunked(batch, K=K)
+    chunk_tab = apply_window_chunked(
+        make_table(D, capacity), chunked, K=K
+    )
+    return seq_tab, chunk_tab
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_differential_fuzz(seed):
+    """Concurrent multi-client streams: the bread-and-butter gate."""
+    _, stream = record_op_stream(FuzzConfig(
+        n_clients=4, n_steps=90, seed=seed,
+        insert_weight=0.55, remove_weight=0.25,
+        annotate_weight=0.05, process_weight=0.15,
+    ))
+    seq_tab, chunk_tab = run_both([stream])
+    assert_live_equal(seq_tab, chunk_tab, f"seed {seed}")
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_differential_fuzz_heavy_process(seed):
+    """High process weight => refseq advances often => many visible
+    cross-client pairs => chunk breaks; exactness must survive."""
+    _, stream = record_op_stream(FuzzConfig(
+        n_clients=3, n_steps=80, seed=1000 + seed,
+        insert_weight=0.45, remove_weight=0.3,
+        annotate_weight=0.1, process_weight=0.3,
+    ))
+    seq_tab, chunk_tab = run_both([stream], K=4)
+    assert_live_equal(seq_tab, chunk_tab, f"hp seed {seed}")
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_differential_fuzz_single_client_chain(seed):
+    """One client typing+backspacing: the pure own-chain composition
+    path (host compiler does all the position arithmetic)."""
+    _, stream = record_op_stream(FuzzConfig(
+        n_clients=1, n_steps=70, seed=2000 + seed,
+        insert_weight=0.55, remove_weight=0.3,
+        annotate_weight=0.1, process_weight=0.05,
+    ))
+    seq_tab, chunk_tab = run_both([stream])
+    assert_live_equal(seq_tab, chunk_tab, f"chain seed {seed}")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_fuzz_multidoc(seed):
+    """Several docs with different shapes share one dispatch; per-doc
+    cursors advance independently."""
+    streams = []
+    for i in range(5):
+        _, s = record_op_stream(FuzzConfig(
+            n_clients=1 + (seed + i) % 4, n_steps=30 + 10 * i,
+            seed=3000 + 10 * seed + i,
+            insert_weight=0.5, remove_weight=0.25,
+            annotate_weight=0.1, process_weight=0.15,
+        ))
+        streams.append(s)
+    seq_tab, chunk_tab = run_both(streams, K=8)
+    assert_live_equal(seq_tab, chunk_tab, f"multidoc seed {seed}")
+
+
+def _raw(ops_rows, window=None):
+    """Build an OpBatch for one doc from raw op dicts."""
+    base = dict(kind=KIND_NOOP, pos1=0, pos2=0, seq=0, refseq=0,
+                client=0, op_id=0, length=0, is_marker=0,
+                prop_key=0, prop_val=0, min_seq=0)
+    rows = [dict(base, **r) for r in ops_rows]
+    W = window or len(rows)
+    arrs = {
+        f: np.zeros((1, W), np.int32) for f in OpBatch._fields
+    }
+    arrs["kind"][:] = KIND_NOOP
+    for w, r in enumerate(rows):
+        for f in OpBatch._fields:
+            arrs[f][0, w] = r[f]
+    return OpBatch(**{f: arrs[f] for f in OpBatch._fields})
+
+
+def _run_raw(rows, capacity=64, K=8):
+    batch = _raw(rows)
+    seq_tab = apply_window_impl(make_table(1, capacity), batch)
+    chunk_tab = apply_window_chunked(
+        make_table(1, capacity), build_chunked(batch, K=K), K=K
+    )
+    return seq_tab, chunk_tab
+
+
+def test_same_client_typing_burst_coalesces_into_one_chunk():
+    """abcdef typed one char at a time: one chunk, one macro-step."""
+    rows = [
+        dict(kind=KIND_INSERT, pos1=i, seq=i + 1, refseq=0,
+             client=0, op_id=i, length=1)
+        for i in range(6)
+    ]
+    batch = _raw(rows)
+    chunked = build_chunked(batch, K=8)
+    assert chunked["chunk_start"][0].tolist() == [1, 0, 0, 0, 0, 0]
+    seq_tab, chunk_tab = _run_raw(rows)
+    assert_live_equal(seq_tab, chunk_tab, "typing burst")
+    # the whole burst resolves to six slots in order
+    assert int(np.asarray(chunk_tab.count)[0]) == 6
+
+
+def test_backspace_run_stays_one_chunk():
+    """Type 4 chars then backspace 2: own-chain removes compose."""
+    rows = [
+        dict(kind=KIND_INSERT, pos1=i, seq=i + 1, refseq=0,
+             client=0, op_id=i, length=1)
+        for i in range(4)
+    ] + [
+        dict(kind=KIND_REMOVE, pos1=3, pos2=4, seq=5, refseq=0,
+             client=0),
+        dict(kind=KIND_REMOVE, pos1=2, pos2=3, seq=6, refseq=0,
+             client=0),
+    ]
+    batch = _raw(rows)
+    chunked = build_chunked(batch, K=8)
+    assert chunked["chunk_start"][0].tolist() == [1, 0, 0, 0, 0, 0]
+    seq_tab, chunk_tab = _run_raw(rows)
+    assert_live_equal(seq_tab, chunk_tab, "backspace run")
+
+
+def test_concurrent_same_position_inserts_order():
+    """Two blind clients at position 0: later sequenced lands left
+    (breakTie: sequenced seq exceeds slot seq)."""
+    rows = [
+        dict(kind=KIND_INSERT, pos1=0, seq=1, refseq=0, client=0,
+             op_id=0, length=2),
+        dict(kind=KIND_INSERT, pos1=0, seq=2, refseq=0, client=1,
+             op_id=1, length=3),
+        dict(kind=KIND_INSERT, pos1=0, seq=3, refseq=0, client=2,
+             op_id=2, length=1),
+    ]
+    seq_tab, chunk_tab = _run_raw(rows)
+    assert_live_equal(seq_tab, chunk_tab, "same-pos storm")
+
+
+def test_cross_client_visible_dependency_breaks_chunk():
+    """Client 1 saw client 0's insert (refseq >= its seq): the chunk
+    must break, then still converge bit-identically."""
+    rows = [
+        dict(kind=KIND_INSERT, pos1=0, seq=1, refseq=0, client=0,
+             op_id=0, length=4),
+        dict(kind=KIND_INSERT, pos1=2, seq=2, refseq=1, client=1,
+             op_id=1, length=2),
+        dict(kind=KIND_REMOVE, pos1=1, pos2=3, seq=3, refseq=2,
+             client=0),
+    ]
+    batch = _raw(rows)
+    chunked = build_chunked(batch, K=8)
+    assert chunked["chunk_start"][0].tolist()[:2] == [1, 1]
+    seq_tab, chunk_tab = _run_raw(rows)
+    assert_live_equal(seq_tab, chunk_tab, "cross visible")
+
+
+def test_remove_then_insert_at_tombstone_boundary():
+    """Insert lands exactly at an own fresh tombstone: breakTie puts
+    it BEFORE the removed text."""
+    rows = [
+        dict(kind=KIND_INSERT, pos1=0, seq=1, refseq=0, client=0,
+             op_id=0, length=6),
+    ]
+    # sequence the big insert first (separate chunk via refseq seen)
+    rows += [
+        dict(kind=KIND_REMOVE, pos1=2, pos2=4, seq=2, refseq=1,
+             client=0),
+        dict(kind=KIND_INSERT, pos1=2, seq=3, refseq=1, client=0,
+             op_id=1, length=1),
+    ]
+    seq_tab, chunk_tab = _run_raw(rows)
+    assert_live_equal(seq_tab, chunk_tab, "tombstone boundary")
+
+
+def test_annotate_lww_within_chunk():
+    rows = [
+        dict(kind=KIND_INSERT, pos1=0, seq=1, refseq=0, client=0,
+             op_id=0, length=5),
+        dict(kind=2, pos1=0, pos2=5, seq=2, refseq=1, client=0,
+             prop_key=1, prop_val=7),
+        dict(kind=2, pos1=1, pos2=3, seq=3, refseq=1, client=0,
+             prop_key=1, prop_val=9),
+    ]
+    seq_tab, chunk_tab = _run_raw(rows)
+    assert_live_equal(seq_tab, chunk_tab, "annotate lww")
+
+
+def test_overflow_flags_match_and_doc_parks():
+    rows = [
+        dict(kind=KIND_INSERT, pos1=0, seq=i + 1, refseq=0,
+             client=0, op_id=i, length=1)
+        for i in range(10)
+    ]
+    batch = _raw(rows)
+    seq_tab = apply_window_impl(make_table(1, 4), batch)
+    chunk_tab = apply_window_chunked(
+        make_table(1, 4), build_chunked(batch, K=8), K=8
+    )
+    assert int(np.asarray(seq_tab.overflow)[0]) == 1
+    assert int(np.asarray(chunk_tab.overflow)[0]) == 1
+
+
+def test_min_seq_advance_rides_noops():
+    rows = [
+        dict(kind=KIND_INSERT, pos1=0, seq=1, refseq=0, client=0,
+             op_id=0, length=3),
+        dict(kind=KIND_NOOP, min_seq=1),
+        dict(kind=KIND_REMOVE, pos1=0, pos2=1, seq=2, refseq=1,
+             client=0, min_seq=1),
+    ]
+    seq_tab, chunk_tab = _run_raw(rows)
+    assert_live_equal(seq_tab, chunk_tab, "noop min_seq")
