@@ -88,6 +88,11 @@ def load_native_sequencer() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, i64, p_i64, p_i64, p_i64,
             p_i64, p_i64, p_i32,
         ]
+        lib.seq_ticket_multi.restype = i64
+        lib.seq_ticket_multi.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), i64, p_i64,
+            p_i64, p_i64, p_i64, p_i64, p_i64, p_i32,
+        ]
         lib.seq_export_clients.restype = i64
         lib.seq_export_clients.argtypes = [
             ctypes.c_void_p, i64, p_i64, p_i64, p_i64,

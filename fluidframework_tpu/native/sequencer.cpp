@@ -161,6 +161,27 @@ int64_t seq_ticket_batch(
     return ok;
 }
 
+// Multi-document boxcar: ticket every document's op slice in ONE
+// call — the Kafka boxcar shape (the deli lambda consumes message
+// boxes grouped by document; lambdas/src/deli/lambda.ts rebatches the
+// same way). Op arrays are flattened with doc_start[d]..doc_start[d+1]
+// delimiting document d's slice. Returns total TICKET_OK count.
+int64_t seq_ticket_multi(
+    void** handles, int64_t n_docs, const int64_t* doc_start,
+    const int64_t* client_ids, const int64_t* csns,
+    const int64_t* ref_seqs,
+    int64_t* out_seq, int64_t* out_msn, int32_t* out_status) {
+    int64_t ok = 0;
+    for (int64_t d = 0; d < n_docs; ++d) {
+        const int64_t a = doc_start[d], b = doc_start[d + 1];
+        if (b <= a) continue;
+        ok += seq_ticket_batch(
+            handles[d], b - a, client_ids + a, csns + a, ref_seqs + a,
+            out_seq + a, out_msn + a, out_status + a);
+    }
+    return ok;
+}
+
 // Checkpoint export: fill parallel arrays (capacity must be
 // >= seq_client_count). Returns the client count written.
 int64_t seq_export_clients(

@@ -269,3 +269,72 @@ class NativeSequencerCore:
                 c["client_sequence_number"],
             )
         return core
+
+
+class MultiDocSequencer:
+    """A fleet of native sequencers ticketed in ONE FFI call per
+    boxcar — the deli lambda's Kafka message-box shape (ops grouped by
+    document, lambdas/src/deli/lambda.ts): the service plane's
+    full-corpus replay path crosses the FFI boundary once per round,
+    not once per document."""
+
+    def __init__(self, n_docs: int):
+        from . import load_native_sequencer
+
+        lib = load_native_sequencer()
+        if lib is None:
+            from . import native_build_error
+
+            raise RuntimeError(
+                f"native sequencer unavailable: {native_build_error()}"
+            )
+        self._lib = lib
+        self.n_docs = n_docs
+        self._handles = (ctypes.c_void_p * n_docs)(
+            *(lib.seq_create(0, 0) for _ in range(n_docs))
+        )
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        lib = getattr(self, "_lib", None)
+        handles = getattr(self, "_handles", None)
+        if lib is not None and handles is not None:
+            for h in handles:
+                if h:
+                    lib.seq_destroy(h)
+            self._handles = None
+
+    def join(self, doc: int, client_idx: int) -> int:
+        """Join an (interned) client to one document's quorum."""
+        return self._lib.seq_client_join(self._handles[doc], client_idx)
+
+    def doc_sequence_number(self, doc: int) -> int:
+        return self._lib.seq_sequence_number(self._handles[doc])
+
+    def ticket_boxcar(self, doc_start, cids, csns, refs):
+        """Ticket one boxcar: flattened per-doc op slices delimited by
+        ``doc_start`` (len n_docs+1). Returns (seq, msn, status) int64
+        /int32 arrays aligned with the inputs — the numeric form the
+        device OpBatch consumes directly (zero per-op Python)."""
+        import numpy as np
+
+        doc_start = np.ascontiguousarray(doc_start, np.int64)
+        cids = np.ascontiguousarray(cids, np.int64)
+        csns = np.ascontiguousarray(csns, np.int64)
+        refs = np.ascontiguousarray(refs, np.int64)
+        n = len(cids)
+        out_seq = np.empty(n, np.int64)
+        out_msn = np.empty(n, np.int64)
+        out_status = np.empty(n, np.int32)
+        p64 = ctypes.POINTER(ctypes.c_int64)
+        p32 = ctypes.POINTER(ctypes.c_int32)
+        self._lib.seq_ticket_multi(
+            self._handles, self.n_docs,
+            doc_start.ctypes.data_as(p64),
+            cids.ctypes.data_as(p64),
+            csns.ctypes.data_as(p64),
+            refs.ctypes.data_as(p64),
+            out_seq.ctypes.data_as(p64),
+            out_msn.ctypes.data_as(p64),
+            out_status.ctypes.data_as(p32),
+        )
+        return out_seq, out_msn, out_status

@@ -124,6 +124,37 @@ def apply_window(table: SegmentTable, batch: OpBatch) -> SegmentTable:
     return _apply_window_xla(table, batch)
 
 
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(1,))
+def pad_capacity(table: SegmentTable, new_capacity: int) -> SegmentTable:
+    """Widen the slot slab without touching content: live slots and
+    doc scalars carry over, new slots are garbage beyond ``count``.
+    This is what makes regrow O(window): pad the pre-dispatch snapshot
+    and re-apply just the failed window (the snapshot is a free handle
+    — JAX arrays are immutable)."""
+    grow = new_capacity - table.capacity
+    assert grow > 0
+
+    def pad(arr, fill=0):
+        widths = [(0, 0), (0, grow)] + [(0, 0)] * (arr.ndim - 2)
+        return jnp.pad(arr, widths, constant_values=fill)
+
+    return table._replace(
+        length=pad(table.length),
+        seq=pad(table.seq),
+        client=pad(table.client),
+        removed_seq=pad(table.removed_seq, NOT_REMOVED),
+        removers=pad(table.removers),
+        op_id=pad(table.op_id),
+        op_off=pad(table.op_off),
+        is_marker=pad(table.is_marker),
+        prop=pad(table.prop),
+        overflow=jnp.zeros_like(table.overflow),
+    )
+
+
 @jax.jit
 def compact(table: SegmentTable) -> SegmentTable:
     """Zamboni kernel (mergeTree.ts:800): drop tombstones at/below the
