@@ -160,6 +160,9 @@ class CachingDocumentService:
     def read_ops(self, from_seq: int, to_seq=None):
         return self._inner.read_ops(from_seq, to_seq)
 
+    def upload_summary(self, summary: dict) -> str:
+        return self._inner.upload_summary(summary)
+
     def connect_to_delta_stream(self, client_id, on_message,
                                 on_nack=None):
         return self._inner.connect_to_delta_stream(
@@ -230,6 +233,11 @@ class _DocumentFacade:
     def get_latest_summary(self):
         return self._client._doc_latest_summary(
             self.document_id, auth=(self.tenant_id, self.token))
+
+    def upload_summary(self, summary: dict) -> str:
+        return self._client._doc_upload_summary(
+            self.document_id, summary,
+            auth=(self.tenant_id, self.token))
 
     def close(self) -> None:
         # tell the server to drop this document's connection (leave

@@ -295,6 +295,49 @@ class SocketDocumentService:
         frame = self._request(data)
         return [message_from_json(m) for m in frame["msgs"]]
 
+    def upload_summary(self, summary: dict) -> str:
+        """Upload a summary tree to service storage and return its
+        root handle — the storage half of the reference's summarize
+        flow (driver-definitions/src/storage.ts:119
+        uploadSummaryWithContext): the summarize op then proposes the
+        handle instead of carrying the tree on the op stream."""
+        return self._doc_upload_summary(
+            self.document_id, summary,
+            auth=(self.tenant_id, self.token))
+
+    _UPLOAD_CHUNK = 512 * 1024
+
+    def _doc_upload_summary(self, document_id: str, summary: dict,
+                            auth=None) -> str:
+        """Chunks PIPELINE: intermediate frames are fire-and-forget
+        (TCP ordering + backpressure carry them) and only the final
+        chunk is a waited request — one round trip per upload, so a
+        large summary does not hold the dispatch path hostage for
+        total/chunk RTTs (matters most on the multiplexed socket,
+        where every document shares one connection)."""
+        from ..protocol.serialization import encode_contents
+
+        payload = json.dumps(encode_contents(summary))
+        parts = [
+            payload[i:i + self._UPLOAD_CHUNK]
+            for i in range(0, len(payload), self._UPLOAD_CHUNK)
+        ] or [""]
+        upload_id = f"u{next(self._rid)}"
+        for i, part in enumerate(parts):
+            data = {
+                "type": "upload_summary_chunk",
+                "document_id": document_id,
+                "upload_id": upload_id,
+                "chunk": i, "total": len(parts), "data": part,
+            }
+            if auth is not None and auth[1] is not None:
+                data["tenant_id"], data["token"] = auth
+            if i + 1 < len(parts):
+                self._send(data)
+            else:
+                frame = self._request(data)
+        return frame["handle"]
+
     def _doc_latest_summary(self, document_id: str, auth=None
                             ) -> Optional[tuple[int, dict]]:
         data = {

@@ -438,6 +438,34 @@ class Container(EventEmitter):
             "runtime": self.runtime.summarize(unchanged),
         }
         if self.connected:
+            # the reference flow (containerRuntime.ts:2477): upload
+            # the tree to storage, then propose only the handle on the
+            # op stream; drivers without a storage upload plane (the
+            # in-proc local/file drivers) carry the tree inline
+            upload = getattr(self.service, "upload_summary", None)
+            contents = None
+            if upload is not None:
+                try:
+                    contents = {
+                        "handle": upload(summary),
+                        "referenceSequenceNumber": (
+                            self.last_processed_seq
+                        ),
+                    }
+                except (OSError, RuntimeError, TimeoutError) as e:
+                    # a transient storage-upload failure must not
+                    # wedge the summarizer (the proposal would never
+                    # exist, so no ack/nack would ever clear it):
+                    # degrade to the inline path — a fat op, but the
+                    # loop completes
+                    self.mc.logger.send_error_event(
+                        "summaryUploadFailed", error=e,
+                    )
+            if contents is None:
+                contents = {
+                    "summary": summary,
+                    "referenceSequenceNumber": self.last_processed_seq,
+                }
             self._csn += 1
             self._pending_summary_counts = counts
             self._pending_summary_csn = self._csn
@@ -445,10 +473,7 @@ class Container(EventEmitter):
                 client_sequence_number=self._csn,
                 reference_sequence_number=self.last_processed_seq,
                 type=MessageType.SUMMARIZE,
-                contents={
-                    "summary": summary,
-                    "referenceSequenceNumber": self.last_processed_seq,
-                },
+                contents=contents,
             ))
         return summary
 

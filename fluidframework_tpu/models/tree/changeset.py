@@ -109,6 +109,227 @@ def tomb(n: int, key: list, was: Mark) -> Mark:
     return {"t": "tomb", "n": n, "key": key, "was": was}
 
 
+# ---------------------------------------------------------------------------
+# register field kind (modular-schema: value / optional fields)
+#
+# The second FieldKind in the algebra (reference:
+# packages/dds/tree/src/feature-libraries/modular-schema/ — FieldKind-
+# indexed composition; the value/optional kinds there are LWW
+# registers). A register field holds at most one node; its change is a
+# DICT (sequence-kind changes are lists, so the kind dispatches on the
+# change's own shape):
+#
+#   {"k": "reg", "opt": bool,
+#    "mods": [marks]?,    # changes to the CURRENT node (a <=1-node
+#                         # sequence — the whole sequence mark algebra
+#                         # is reused for the nested piece)
+#    "set":  {"new": node|None, "old": node|None,
+#             "sid": [uid, n]?, "undoes": [uid, n]?}?,
+#    "post": [marks]?,    # changes to the NEW node (arises from
+#                         # inversion/composition; applies after set)
+#    "muted": [{"mods": [...], "by": [uid, n]}, ...]?}
+#
+# Order of application: mods, set, post. Concurrency: sets are LWW by
+# sequencing (the later-sequenced set wins — both apply, last writer's
+# node stands); nested mods whose target node a concurrent set
+# replaced MUTE under the set's identity and unmute when that set's
+# inverse rebases over them (the same tombstone discipline the
+# sequence kind uses, which is what keeps the EditManager's
+# invert/rebase sandwich exact).
+#
+# Composition note: composing "set A then interior churn then set B"
+# collapses the interior churn (net effect preserved through the
+# old/new chain — the reference's register kinds likewise do not
+# support reviving register-replaced content across a composite).
+
+
+def is_reg(change: Any) -> bool:
+    return isinstance(change, dict) and change.get("k") == "reg"
+
+
+def reg_set(new: Optional[dict], old: Optional[dict],
+            optional: bool = True) -> dict:
+    """Author a register write: replace the field's node with ``new``
+    (None clears an optional field). ``old`` is the author's current
+    view — the inverse restores it."""
+    if new is None and not optional:
+        raise ValueError("value field cannot be cleared")
+    return {"k": "reg", "opt": bool(optional),
+            "set": {"new": copy.deepcopy(new),
+                    "old": copy.deepcopy(old)}}
+
+
+def reg_mods(marks: MarkList, optional: bool = True) -> dict:
+    """Nested changes to the register field's current node."""
+    return {"k": "reg", "opt": bool(optional), "mods": marks}
+
+
+def _reg_normalize(r: dict) -> Optional[dict]:
+    out = {"k": "reg", "opt": r.get("opt", True)}
+    mods = normalize(r.get("mods") or [])
+    if mods:
+        out["mods"] = mods
+    if r.get("set") is not None:
+        out["set"] = r["set"]
+    post = normalize(r.get("post") or [])
+    if post:
+        out["post"] = post
+    muted = [e for e in (r.get("muted") or []) if normalize(
+        e.get("mods") or [])]
+    if muted:
+        out["muted"] = muted
+    if len(out) == 2:  # only k + opt: no effect
+        return None
+    return out
+
+
+def _reg_lower(r: dict) -> MarkList:
+    """Lower a register change to sequence marks over the author's
+    view (old tells whether a node was present). CONVERGENCE VALVE for
+    mixed-kind concurrent editing of one field (one client used the
+    sequence surface, another the register surface — an application
+    modeling error, but it must merge deterministically, never wedge
+    the document): once kinds clash, the register change joins the
+    sequence algebra as delete-then-insert."""
+    marks: MarkList = list(r.get("mods") or [])
+    s = r.get("set")
+    if s is not None:
+        lowered: MarkList = []
+        if s.get("old") is not None:
+            lowered.append(dele(1))
+        new = s.get("new")
+        if new is not None:
+            if r.get("post"):
+                for pm in r["post"]:
+                    if pm["t"] == "mod":
+                        new = _mod_node(new, pm)
+            lowered.append(ins([copy.deepcopy(new)]))
+        marks = _compose_marks(marks, lowered) if marks else lowered
+    # muted pieces stay muted (tomb-equivalent: nothing to lower)
+    return normalize(marks)
+
+
+def _compose_reg(a: Any, b: Any) -> Optional[dict]:
+    """Net effect of register change ``a`` followed by ``b``."""
+    if (a and not is_reg(a)) or (b and not is_reg(b)):
+        # mixed kinds: lower the register side and compose as sequence
+        am = _reg_lower(a) if is_reg(a) else (a or [])
+        bm = _reg_lower(b) if is_reg(b) else (b or [])
+        return _compose_marks(am, bm) or None
+    a = a or {"k": "reg"}
+    b = b or {"k": "reg"}
+    opt = a.get("opt", b.get("opt", True))
+    muted = list(a.get("muted") or []) + list(b.get("muted") or [])
+    if b.get("set") is not None:
+        if a.get("set") is not None:
+            # interior churn (a.post, b.mods) is replaced by b's set;
+            # the old/new chain preserves the net effect
+            out = {"k": "reg", "opt": opt, "mods": a.get("mods"),
+                   "set": dict(b["set"], old=a["set"]["old"]),
+                   "post": b.get("post")}
+        else:
+            out = {"k": "reg", "opt": opt,
+                   "mods": _compose_marks(a.get("mods") or [],
+                                          b.get("mods") or []),
+                   "set": b["set"], "post": b.get("post")}
+    elif a.get("set") is not None:
+        out = {"k": "reg", "opt": opt, "mods": a.get("mods"),
+               "set": a["set"],
+               "post": _compose_marks(a.get("post") or [],
+                                      b.get("mods") or [])}
+    else:
+        out = {"k": "reg", "opt": opt,
+               "mods": _compose_marks(a.get("mods") or [],
+                                      b.get("mods") or [])}
+    if muted:
+        out["muted"] = muted
+    return _reg_normalize(out)
+
+
+def _invert_reg(r: dict, uid: Any, counters: dict) -> Optional[dict]:
+    """Pieces invert in reverse order: invert(post), set-back,
+    invert(mods). Muted intent never applied — its inverse is
+    nothing (same rule as tombs)."""
+    out = {"k": "reg", "opt": r.get("opt", True)}
+    if r.get("post"):
+        out["mods"] = _invert_marks(r["post"], uid, counters)
+    if r.get("set") is not None:
+        s = r["set"]
+        inv = {"new": copy.deepcopy(s.get("old")),
+               "old": copy.deepcopy(s.get("new"))}
+        if s.get("sid") is not None:
+            inv["undoes"] = s["sid"]
+        out["set"] = inv
+    if r.get("mods"):
+        out["post"] = _invert_marks(r["mods"], uid, counters)
+    return _reg_normalize(out)
+
+
+def _rebase_reg(c: Any, o: Any) -> Optional[dict]:
+    """Re-express register change ``c`` to apply after ``o``."""
+    if (c and not is_reg(c)) or (o and not is_reg(o)):
+        # mixed kinds: lower to the sequence algebra (see _reg_lower)
+        cm = _reg_lower(c) if is_reg(c) else (c or [])
+        om = _reg_lower(o) if is_reg(o) else (o or [])
+        return _rebase_marks(cm, om) or None
+    c = c or {"k": "reg"}
+    o = o or {"k": "reg"}
+    out = {"k": "reg", "opt": c.get("opt", o.get("opt", True))}
+    o_set = o.get("set")
+    muted: list = []
+    unmuted: MarkList = []
+    # unmute entries whose killer o's set undoes (the node is back);
+    # they target the node o RESTORED, so they stay active past the
+    # muting step below
+    for e in c.get("muted") or []:
+        if o_set is not None and o_set.get("undoes") is not None \
+                and e.get("by") == o_set["undoes"]:
+            back = e.get("mods") or []
+            # the restored node may have been touched by o.post
+            back = _rebase_marks(back, o.get("post") or [])
+            unmuted = _compose_marks(unmuted, back) \
+                if unmuted else back
+        else:
+            muted.append(e)
+    active_mods = c.get("mods") or []
+    if o_set is not None:
+        # o replaced (or cleared) the node c's mods targeted: mute
+        # them under o's set identity; c's own set still applies (LWW
+        # by sequencing) and c.post rides c's own new node
+        if active_mods:
+            muted.append({"mods": active_mods, "by": o_set.get("sid")})
+            active_mods = []
+    else:
+        active_mods = _rebase_marks(active_mods, o.get("mods") or [])
+    if unmuted:
+        active_mods = _compose_marks(active_mods, unmuted) \
+            if active_mods else unmuted
+    if active_mods:
+        out["mods"] = active_mods
+    if c.get("set") is not None:
+        out["set"] = c["set"]
+    if c.get("post"):
+        out["post"] = c["post"]
+    if muted:
+        out["muted"] = muted
+    return _reg_normalize(out)
+
+
+def _reg_apply(seq: list, r: dict, apply_marks) -> list:
+    """Apply a register change to the field's (<=1 node) content.
+    ``apply_marks(seq, marks)`` applies a nested mark list (callers
+    supply their walker so repair hooks ride along)."""
+    out = seq
+    if r.get("mods"):
+        out = apply_marks(out, r["mods"])
+    if r.get("set") is not None:
+        new = r["set"].get("new")
+        out = [copy.deepcopy(new)] if new is not None else []
+    if r.get("post"):
+        out = apply_marks(out, r["post"])
+    return out
+
+
 def move(src: int, count: int, dst: int, pair: Any = None) -> MarkList:
     """Same-field move of ``count`` nodes from input position ``src``
     to input position ``dst`` (outside the moved range), expressed as
@@ -307,7 +528,8 @@ def normalize(marks: MarkList) -> MarkList:
 def normalize_fields(changes: FieldChanges) -> FieldChanges:
     out = {}
     for key, marks in changes.items():
-        nm = normalize(marks)
+        nm = _reg_normalize(marks) if is_reg(marks) else \
+            normalize(marks)
         if nm:
             out[key] = nm
     return out
@@ -332,6 +554,11 @@ def stamp(changes: FieldChanges, uid: str) -> FieldChanges:
 
 def _resolve_moves(changes: FieldChanges, pairs: dict) -> None:
     for key in sorted(changes):
+        if is_reg(changes[key]):
+            for piece in ("mods", "post"):
+                if changes[key].get(piece):
+                    _resolve_moves({key: changes[key][piece]}, pairs)
+            continue
         for m in changes[key]:
             if m["t"] == "rev" and m.get("rev") is None:
                 did = pairs.get(m.get("mv"))
@@ -347,6 +574,16 @@ def _resolve_moves(changes: FieldChanges, pairs: dict) -> None:
 def _stamp_fields(changes: FieldChanges, uid: str, counters: dict,
                   pairs: Optional[dict] = None) -> None:
     for key in sorted(changes):
+        if is_reg(changes[key]):
+            r = changes[key]
+            if r.get("set") is not None and "sid" not in r["set"]:
+                r["set"]["sid"] = [uid, counters.setdefault("s", 0)]
+                counters["s"] += 1
+            for piece in ("mods", "post"):
+                if r.get(piece):
+                    _stamp_fields({key: r[piece]}, uid, counters,
+                                  pairs)
+            continue
         for m in changes[key]:
             t = m["t"]
             if t == "ins":
@@ -378,7 +615,13 @@ def compose(changes: list[FieldChanges]) -> FieldChanges:
 def _compose2(a: FieldChanges, b: FieldChanges) -> FieldChanges:
     out: FieldChanges = {}
     for key in sorted(set(a) | set(b)):
-        marks = _compose_marks(a.get(key, []), b.get(key, []))
+        av, bv = a.get(key), b.get(key)
+        if is_reg(av) or is_reg(bv):
+            reg = _compose_reg(av, bv)
+            if reg:
+                out[key] = reg
+            continue
+        marks = _compose_marks(av or [], bv or [])
         if marks:
             out[key] = marks
     return out
@@ -404,7 +647,11 @@ def _mod_node(node: dict, m: Mark) -> dict:
         node["value"] = m["value"]["new"]
     for key, marks in (m.get("fields") or {}).items():
         seq = node.setdefault("fields", {}).get(key, [])
-        node["fields"][key] = _apply_marks_to_content(seq, marks)
+        if is_reg(marks):
+            node["fields"][key] = _reg_apply(
+                seq, marks, _apply_marks_to_content)
+        else:
+            node["fields"][key] = _apply_marks_to_content(seq, marks)
     return node
 
 
@@ -610,6 +857,11 @@ def _invert_fields(changes: FieldChanges, uid: Any,
                    counters: dict) -> FieldChanges:
     out: FieldChanges = {}
     for key in sorted(changes):
+        if is_reg(changes[key]):
+            inv = _invert_reg(changes[key], uid, counters)
+            if inv:
+                out[key] = inv
+            continue
         out[key] = _invert_marks(changes[key], uid, counters)
     return normalize_fields(out)
 
@@ -658,7 +910,13 @@ def rebase(change: FieldChanges, over: FieldChanges) -> FieldChanges:
     same base as ``over``) so it applies after ``over``."""
     out: FieldChanges = {}
     for key in sorted(set(change) | set(over)):
-        marks = _rebase_marks(change.get(key, []), over.get(key, []))
+        cv, ov = change.get(key), over.get(key)
+        if is_reg(cv) or is_reg(ov):
+            reg = _rebase_reg(cv, ov)
+            if reg:
+                out[key] = reg
+            continue
+        marks = _rebase_marks(cv or [], ov or [])
         if marks:
             out[key] = marks
     return out

@@ -70,6 +70,15 @@ class EditableField:
         valid-but-wrong."""
         self._tree.move_nodes(self._path, src, count, dst)
 
+    def set(self, content) -> None:
+        """Register-field write (value/optional kinds): replace the
+        field's single node; concurrent sets converge LWW."""
+        self._tree.set_register(self._path, content)
+
+    def clear(self) -> None:
+        """Clear an optional register field."""
+        self._tree.set_register(self._path, None)
+
     def __delitem__(self, i) -> None:
         if isinstance(i, slice):
             start, stop, step = i.indices(len(self))

@@ -22,7 +22,14 @@ import copy
 import json
 from typing import Any, Optional
 
-from .changeset import FieldChanges, Mark, MarkList, walk_apply
+from .changeset import (
+    FieldChanges,
+    Mark,
+    MarkList,
+    _reg_apply as reg_apply,
+    is_reg,
+    walk_apply,
+)
 
 
 def node(type_: str, value: Any = None,
@@ -73,8 +80,19 @@ class Forest:
     def _capture_fields(self, fields: dict, changes: FieldChanges,
                         revision: Any, counter: list) -> None:
         for key in sorted(changes):
+            ch = changes[key]
+            if is_reg(ch):
+                # register fields: only the nested mods touch existing
+                # content (the set's old rides inline; post applies to
+                # fresh content and captures late, during apply)
+                if ch.get("mods"):
+                    self._capture_marks(
+                        fields.get(key, []), ch["mods"], revision,
+                        counter,
+                    )
+                continue
             self._capture_marks(
-                fields.get(key, []), changes[key], revision, counter
+                fields.get(key, []), ch, revision, counter
             )
 
     def _capture_marks(self, seq: list, marks: MarkList,
@@ -109,8 +127,16 @@ class Forest:
     def _apply_fields(self, fields: dict, changes: FieldChanges,
                       revision: Any, counter: list) -> None:
         for key in sorted(changes):
+            ch = changes[key]
+            if is_reg(ch):
+                fields[key] = reg_apply(
+                    fields.get(key, []), ch,
+                    lambda seq, marks: self._apply_marks(
+                        seq, marks, revision, counter),
+                )
+                continue
             fields[key] = self._apply_marks(
-                fields.get(key, []), changes[key], revision, counter)
+                fields.get(key, []), ch, revision, counter)
 
     def _apply_marks(self, seq: list, marks: MarkList,
                      revision: Any, counter: list) -> list:

@@ -6,20 +6,20 @@ persisted schema) and schema-view. The reference registers field kinds
 (value / optional / sequence / forbidden) and per-node-type allowed
 child types; the stored schema is itself replicated document state.
 
-TPU-native re-design: one concrete field-kind family (sequence, with
-value/optional as cardinality constraints over it — the same collapse
-the changeset algebra makes), JSON-safe schema documents that ride ops
-and summaries unchanged, and validation at the editing surface so a
-schema violation fails BEFORE an op is authored.
+TPU-native re-design: TWO concrete field-kind families — sequence
+(the mark algebra) and REGISTER (value/optional fields: LWW
+single-node writes, changeset.reg_set — the modular-schema second
+kind). JSON-safe schema documents ride ops and summaries unchanged,
+and validation happens at the editing surface so a schema violation
+fails BEFORE an op is authored.
 
-Known limitation (shared with optimistic schema systems): TYPE and
-VALUE constraints cannot be violated by merging (each inserted node is
-validated by its author), but CARDINALITY (value/optional) is checked
-against the author's local view — two clients concurrently filling an
-empty optional field both validate locally yet merge to two nodes.
-The reference addresses this class with its op constraint framework;
-here, readers can detect drift via ``validate_tree`` and repair at the
-application level.
+Cardinality under concurrency: value/optional fields edited through
+the register kind (SharedTree.set_register / EditableField.set)
+converge LWW — two clients concurrently filling an empty optional
+field merge to ONE winner. Sequence-kind editing of a value/optional
+field (insert/delete) remains subject to the optimistic-cardinality
+caveat (author-local validation), same as any optimistic schema
+system; readers can detect drift via ``validate_tree``.
 """
 from __future__ import annotations
 

@@ -28,7 +28,7 @@ from . import changeset as cs
 from .changeset import FieldChanges
 from .editmanager import Commit, EditManager
 from .forest import Forest, node
-from .schema import StoredSchema
+from .schema import SchemaViolation, StoredSchema
 
 
 def wrap_path(path: Sequence, leaf_marks: list) -> FieldChanges:
@@ -222,6 +222,35 @@ class SharedTree(SharedObject, EventEmitter):
         constraints are unaffected. Concurrency: delete wins — see
         changeset.move."""
         self._apply_local(wrap_path(path, cs.move(src, count, dst)))
+
+    def set_register(self, path: Sequence, content: Optional[dict]
+                     ) -> None:
+        """Write a value/optional REGISTER field (modular-schema's
+        second field kind): replace the field's single node with
+        ``content`` (None clears an optional field). Concurrent
+        writes are LWW by sequencing — two clients filling the same
+        optional field converge to ONE winner, closing the
+        concurrent-fill drift the sequence-kind collapse had
+        (schema.py's old known-limitation note)."""
+        kind = None
+        if self._schema is not None:
+            fs = self._schema.field_schema(
+                self._parent_type(path), path[-1])
+            kind = fs.kind if fs is not None else None
+            if kind not in (None, "value", "optional"):
+                raise SchemaViolation(
+                    f"set_register on a {kind!r} field")
+            if content is None and kind == "value":
+                raise SchemaViolation("value field cannot be cleared")
+            if content is not None:
+                self._schema.validate_insert(
+                    self._parent_type(path), path[-1], [content], 1,
+                )
+        current = self.get_field(path)
+        old = current[0] if current else None
+        change = cs.reg_set(content, old,
+                            optional=(kind != "value"))
+        self._apply_local(wrap_path(path, change))
 
     def set_value(self, path: Sequence, index: int, value: Any) -> None:
         seq = self.get_field(path)

@@ -178,7 +178,13 @@ class RunningSummarizer(EventEmitter):
         if self.container.runtime.is_dirty or not self.container.connected:
             return  # wait for quiescence (summarize requires it)
         self.attempt_pending = True
-        self.container.summarize()
+        try:
+            self.container.summarize()
+        except Exception:
+            # no proposal was submitted, so no ack/nack will ever
+            # clear the flag — reset it or summaries stop forever
+            self.attempt_pending = False
+            raise
 
 
 class SummaryManager(EventEmitter):

@@ -130,6 +130,11 @@ class _ClientSession:
         # documents this session has passed the token gate for (a
         # disconnect keeps the authorization; the token was validated)
         self.authorized: set[str] = set()
+        # documents write-authorized (write-mode connect or doc:write
+        # token) — the summary-upload plane requires write scope
+        self.write_authorized: set[str] = set()
+        # in-flight chunked summary uploads: upload_id -> state
+        self.uploads: dict[str, dict] = {}
 
     def send(self, data: dict) -> None:
         self.outbound.put_nowait(pack_frame(data))
@@ -246,6 +251,25 @@ class AlfredServer:
             )
         session.authorized.add(doc)
 
+    def _check_write_access(self, session: _ClientSession,
+                            doc: str, frame: dict) -> None:
+        """The summary-upload plane mutates storage: write scope
+        required (historian gates its summary routes the same way)."""
+        if self.tenants is None or doc in session.write_authorized:
+            return
+        from .tenancy import SCOPE_WRITE, AuthError
+
+        try:
+            self.tenants.validate_token(
+                frame.get("token", ""), frame.get("tenant_id", ""),
+                doc, required_scope=SCOPE_WRITE,
+            )
+        except AuthError as e:
+            raise PermissionError(
+                f"no write access to document {doc!r}: {e}"
+            )
+        session.write_authorized.add(doc)
+
     def _dispatch(self, session: _ClientSession, frame: dict) -> None:
         kind = frame.get("type")
         doc = frame.get("document_id")
@@ -295,6 +319,8 @@ class AlfredServer:
             )
             session.connections[doc] = conn
             session.authorized.add(doc)
+            if mode == "write":
+                session.write_authorized.add(doc)
             session.send({
                 "type": "connected", "document_id": doc,
                 "client_id": client_id,
@@ -336,12 +362,82 @@ class AlfredServer:
                 payload["sequence_number"] = latest.sequence_number
                 payload["summary"] = encode_contents(latest.summary)
             session.send(payload)
+        elif kind == "upload_summary_chunk":
+            self._check_write_access(session, doc, frame)
+            self._handle_upload_chunk(session, doc, frame)
         elif kind == "disconnect_document":
             conn = session.connections.pop(doc, None)
             if conn is not None:
                 conn.disconnect()
         else:
             raise ValueError(f"unknown frame type {kind!r}")
+
+    # upload size guards: a hostile client must not balloon server
+    # memory through the staging buffers. Bytes are accounted PER
+    # SESSION across all in-flight uploads (abandoned upload_ids would
+    # otherwise accumulate unbounded), and stale uploads evict
+    # oldest-first past the concurrency cap.
+    MAX_UPLOAD_CHUNK = 1 << 20       # 1 MiB per frame
+    MAX_UPLOAD_TOTAL = 256 << 20     # 256 MiB staged per session
+    MAX_UPLOADS_IN_FLIGHT = 4
+
+    def _handle_upload_chunk(self, session: _ClientSession, doc: str,
+                             frame: dict) -> None:
+        """Chunked client summary upload
+        (driver-definitions/src/storage.ts:119
+        uploadSummaryWithContext; historian's summary POST routes).
+        Chunks arrive in order on the session's TCP stream;
+        intermediate chunks are fire-and-forget (the driver pipelines
+        them and only the final, rid-carrying chunk waits), and the
+        final chunk stages the tree in the document's
+        content-addressed store, returning the root handle for the
+        summarize op."""
+        upload_id = str(frame["upload_id"])
+        chunk_i = int(frame["chunk"])
+        total = int(frame["total"])
+        data = frame["data"]
+        if not isinstance(data, str) or \
+                len(data) > self.MAX_UPLOAD_CHUNK:
+            raise ValueError("upload chunk too large or malformed")
+        if total < 1:
+            raise ValueError("malformed upload")
+        state = session.uploads.get(upload_id)
+        if state is None:
+            while len(session.uploads) >= self.MAX_UPLOADS_IN_FLIGHT:
+                # evict the oldest abandoned upload (insertion order)
+                stale = next(iter(session.uploads))
+                session.uploads.pop(stale)
+            state = session.uploads[upload_id] = {
+                "doc": doc, "parts": [], "total": total,
+            }
+        if state["doc"] != doc or state["total"] != total \
+                or chunk_i != len(state["parts"]):
+            session.uploads.pop(upload_id, None)
+            raise ValueError("upload chunk out of order")
+        staged_bytes = sum(
+            len(p) for st in session.uploads.values()
+            for p in st["parts"]
+        )
+        if staged_bytes + len(data) > self.MAX_UPLOAD_TOTAL:
+            session.uploads.pop(upload_id, None)
+            raise ValueError("upload too large")
+        state["parts"].append(data)
+        if chunk_i + 1 < total:
+            if frame.get("rid") is not None:
+                session.send({
+                    "type": "upload_ack", "rid": frame["rid"],
+                    "received": chunk_i,
+                })
+            return
+        session.uploads.pop(upload_id, None)
+        summary = decode_contents(json.loads("".join(state["parts"])))
+        handle = self.local.get_orderer(doc).summary_store.stage(
+            summary
+        )
+        session.send({
+            "type": "summary_uploaded", "rid": frame.get("rid"),
+            "handle": handle,
+        })
 
 
 def _check_durable_layout(data_dir: Optional[str],

@@ -164,10 +164,32 @@ class SummaryStore:
     def write(self, sequence_number: int, summary: dict) -> str:
         """Store a summary (resolving handles); returns the root sha —
         the ack handle clients see (summaryAck.handle)."""
+        return self.commit(sequence_number, self.stage(summary))
+
+    def stage(self, summary: dict) -> str:
+        """The client-upload half of the historian flow
+        (driver-definitions/src/storage.ts:119
+        uploadSummaryWithContext): write the tree CONTENT — resolving
+        incremental handles against the last committed version — and
+        return the root sha WITHOUT recording a version. The sha is
+        the handle a summarize op proposes; scribe's ack commits it."""
         if self._storage is not None:
-            return self._storage.write_summary(sequence_number, summary)
-        prev = self._mem_roots[-1][1] if self._mem_roots else None
-        root = self._trees.write(summary, previous_root=prev)
+            prev = (self._storage.versions[-1].root
+                    if self._storage.versions else None)
+        else:
+            prev = self._mem_roots[-1][1] if self._mem_roots else None
+        return self._trees.write(summary, previous_root=prev)
+
+    def has_tree(self, root: str) -> bool:
+        """Is ``root`` a staged/committed tree in the content store?"""
+        return self._trees.store.has(root)
+
+    def commit(self, sequence_number: int, root: str) -> str:
+        """Record a staged tree as the version at ``sequence_number``
+        (scribe ack — the summary becomes the document's loadable
+        state)."""
+        if self._storage is not None:
+            return self._storage.commit_summary(sequence_number, root)
         self._mem_roots.append((sequence_number, root))
         return root
 
@@ -216,13 +238,32 @@ class ScribeLambda:
     def _handle_summarize(self, msg: SequencedMessage) -> None:
         contents = msg.contents or {}
         summary = contents.get("summary")
-        if not isinstance(summary, dict):
+        staged = contents.get("handle")
+        if isinstance(staged, str) and summary is None:
+            # the reference flow (containerRuntime.ts:2477): the
+            # summarizer client uploaded the tree to storage first and
+            # proposes only the handle; scribe validates it exists and
+            # commits the version
+            if not self.summary_store.has_tree(staged):
+                self._submit_system_op(MessageType.SUMMARY_NACK, {
+                    "summaryProposal": msg.sequence_number,
+                    "message": f"unknown summary handle {staged!r}",
+                })
+                return
+            handle = self.summary_store.commit(
+                msg.sequence_number, staged
+            )
+        elif isinstance(summary, dict):
+            # inline payload (in-proc sessions without a storage plane)
+            handle = self.summary_store.write(
+                msg.sequence_number, summary
+            )
+        else:
             self._submit_system_op(MessageType.SUMMARY_NACK, {
                 "summaryProposal": msg.sequence_number,
                 "message": "malformed summary payload",
             })
             return
-        handle = self.summary_store.write(msg.sequence_number, summary)
         # Ack advances the durable sequence number: ops at/below the
         # summarized seq can be truncated from the log (§3.4).
         if self._op_log is not None:

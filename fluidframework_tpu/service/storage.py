@@ -275,6 +275,11 @@ class DocumentStorage:
                       summary: dict) -> str:
         prev = self.versions[-1].root if self.versions else None
         root = self.trees.write(summary, previous_root=prev)
+        return self.commit_summary(sequence_number, root)
+
+    def commit_summary(self, sequence_number: int, root: str) -> str:
+        """Durably record a staged tree root as a version (the scribe
+        ack of a client-uploaded summary)."""
         version = SummaryVersion(sequence_number, root)
         self.versions.append(version)
         with open(self._versions_path, "a") as f:
