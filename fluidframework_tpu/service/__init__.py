@@ -29,6 +29,8 @@ from .tpu_sidecar import TpuMergeSidecar
 
 __all__ = [
     "AlfredServer",
+    "BrokerServer",
+    "RemoteOrderingQueue",
     "BroadcasterLambda",
     "CopierLambda",
     "CheckpointManager",
@@ -53,3 +55,14 @@ __all__ = [
     "TicketResult",
     "TpuMergeSidecar",
 ]
+
+
+def __getattr__(name):
+    # lazy: `python -m fluidframework_tpu.service.broker` runs the
+    # broker CLI; an eager import here would pre-load the module and
+    # trip runpy's double-import warning
+    if name in ("BrokerServer", "RemoteOrderingQueue"):
+        from . import broker
+
+        return getattr(broker, name)
+    raise AttributeError(name)

@@ -30,8 +30,14 @@ def main() -> None:
                         help="route through N queue partitions (the "
                              "scale-out pipeline shape); 0 = inline "
                              "orderer")
+    parser.add_argument("--broker", default=None,
+                        help="host:port of a running "
+                             "fluidframework_tpu.service.broker — the "
+                             "networked ordering queue (partitions "
+                             "span hosts)")
     args = parser.parse_args()
-    run_server(args.host, args.port, args.data_dir, args.partitions)
+    run_server(args.host, args.port, args.data_dir, args.partitions,
+               args.broker)
 
 
 if __name__ == "__main__":

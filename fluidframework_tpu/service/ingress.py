@@ -440,14 +440,31 @@ class AlfredServer:
         })
 
 
+def _parse_hostport(value: str, default_host: str = "127.0.0.1"
+                    ) -> tuple[str, int]:
+    """Parse "host:port" (IPv6 literals bracketed: "[::1]:7081") with
+    a usable error instead of an int() traceback."""
+    host, sep, port = value.rpartition(":")
+    if not sep or not port.isdigit():
+        raise SystemExit(
+            f"--broker expects host:port, got {value!r}"
+        )
+    host = host or default_host
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    return host, int(port)
+
+
 def _check_durable_layout(data_dir: Optional[str],
-                          partitions: int) -> None:
+                          partitions: int,
+                          queue_source: str = "local") -> None:
     """The inline and partitioned modes use different on-disk layouts,
-    and the partition count is baked into the queue's document->
-    partition routing. Restarting an existing data dir under a
-    different configuration would silently come up empty (or misroute
-    documents to partitions whose logs don't hold their records) —
-    refuse loudly instead."""
+    the partition count is baked into the queue's document->partition
+    routing, and the QUEUE SOURCE (local file queue vs networked
+    broker) determines where unconsumed records live. Restarting an
+    existing data dir under a different configuration would silently
+    come up empty (or misroute documents, or orphan unpumped records
+    in the abandoned queue) — refuse loudly instead."""
     if data_dir is None:
         return
     import json as _json
@@ -456,9 +473,14 @@ def _check_durable_layout(data_dir: Optional[str],
     marker = _os.path.join(data_dir, "layout.json")
     current = {"mode": "partitioned" if partitions > 0 else "inline",
                "partitions": partitions}
+    if partitions > 0:
+        current["queue"] = queue_source
     if _os.path.exists(marker):
         with open(marker) as f:
             stored = _json.load(f)
+        # pre-queue-field markers: local was the only option then
+        if stored.get("mode") == "partitioned":
+            stored.setdefault("queue", "local")
         if stored != current:
             raise SystemExit(
                 f"data dir {data_dir!r} was created with layout "
@@ -483,19 +505,55 @@ def _check_durable_layout(data_dir: Optional[str],
 
 def run_server(host: str = "127.0.0.1", port: int = 7070,
                data_dir: Optional[str] = None,
-               partitions: int = 0) -> None:
+               partitions: int = 0,
+               broker: Optional[str] = None) -> None:
     """Blocking entry point (the tinylicious analogue; see
     service/__main__.py). ``data_dir`` makes every document durable:
     op log, summaries and deli checkpoints survive restarts.
     ``partitions`` > 0 routes everything through the partitioned
     queue pipeline (the kafka-deployment shape) instead of the inline
-    orderer."""
-    _check_durable_layout(data_dir, partitions)
+    orderer; ``broker`` = "host:port" of a running
+    ``service.broker`` — the NETWORKED queue, so partitions span
+    processes/hosts (services-ordering-rdkafka's role)."""
+    queue = None
+    if broker is not None:
+        from .broker import RemoteOrderingQueue
+
+        bhost, bport = _parse_hostport(broker)
+        queue = RemoteOrderingQueue(bhost, bport)
+        if partitions <= 0:
+            partitions = queue.n_partitions
+        elif partitions != queue.n_partitions:
+            # document->partition routing is crc32 % N: a consumer
+            # disagreeing with the broker's N splits document ordering
+            # across partitions (or produces out-of-range)
+            raise SystemExit(
+                f"--partitions {partitions} disagrees with the "
+                f"broker's {queue.n_partitions}; drop --partitions "
+                "or match it"
+            )
+        if data_dir is None and any(
+            queue.committed(p) >= 0 for p in range(partitions)
+        ):
+            # the broker has committed progress but this consumer has
+            # no durable document state: resuming past the committed
+            # offsets would bring every document up silently EMPTY
+            raise SystemExit(
+                "broker has committed offsets but this server has no "
+                "--data-dir: resuming would skip all applied history. "
+                "Point --data-dir at the original state (or a "
+                "replacement host's copy)."
+            )
+    _check_durable_layout(
+        data_dir, partitions,
+        queue_source=f"broker:{broker}" if broker else "local",
+    )
     if partitions > 0:
         from .partitioning import PartitionedServer
 
         local = PartitionedServer(
-            n_partitions=partitions, durable_dir=data_dir)
+            n_partitions=partitions, durable_dir=data_dir,
+            queue=queue)
     else:
         local = LocalServer(durable_dir=data_dir)
     server = AlfredServer(local, host=host, port=port)

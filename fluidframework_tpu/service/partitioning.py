@@ -467,12 +467,12 @@ class PartitionedServer:
 
     def __init__(self, n_partitions: int = 4,
                  durable_dir: Optional[str] = None,
-                 copier=None):
+                 copier=None, queue: Optional[OrderingQueue] = None):
         import itertools as _it
 
         self.svc = PartitionedOrderingService(
             n_partitions=n_partitions, durable_dir=durable_dir,
-            copier=copier, on_nack=self._route_nack,
+            copier=copier, on_nack=self._route_nack, queue=queue,
         )
         self._nack_routes: dict[tuple[str, str], Any] = {}
         self._conn_counter = _it.count()
