@@ -31,19 +31,16 @@ from __future__ import annotations
 import asyncio
 import json
 import socket
-import struct
 import threading
 from typing import Iterator, Optional
 
-from .ingress import pack_frame, read_frame
+from .ingress import pack_frame, read_frame, recv_frame_blocking
 from .partitioning import (
     FileOrderingQueue,
     InMemoryOrderingQueue,
     OrderingQueue,
     QueueRecord,
 )
-
-_LEN = struct.Struct(">I")
 
 
 class BrokerServer:
@@ -179,29 +176,16 @@ class RemoteOrderingQueue(OrderingQueue):
                 try:
                     sock = self._connect()
                     sock.sendall(pack_frame(data))
-                    header = self._recv_exact(sock, _LEN.size)
-                    (length,) = _LEN.unpack(header)
-                    body = self._recv_exact(sock, length)
+                    frame = recv_frame_blocking(sock)
                     break
                 except (OSError, ConnectionError):
                     # broker restarted: drop the socket and retry once
                     self._close_sock()
                     if attempt:
                         raise
-            frame = json.loads(body.decode("utf-8"))
             if frame.get("type") == "error":
                 raise RuntimeError(frame.get("message", "broker error"))
             return frame
-
-    @staticmethod
-    def _recv_exact(sock: socket.socket, n: int) -> bytes:
-        buf = b""
-        while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
-            if not chunk:
-                raise ConnectionError("broker connection closed")
-            buf += chunk
-        return buf
 
     def _close_sock(self) -> None:
         if self._sock is not None:

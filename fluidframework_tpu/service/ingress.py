@@ -112,6 +112,28 @@ async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
     return json.loads(body.decode("utf-8"))
 
 
+def recv_frame_blocking(sock) -> dict:
+    """Read one frame from a BLOCKING socket — the sync-side twin of
+    ``read_frame`` (one definition of the wire framing for clients
+    without an event loop, e.g. the broker's request/response
+    client)."""
+    buf = b""
+    need = 4
+    while len(buf) < need:
+        chunk = sock.recv(need - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf += chunk
+    (length,) = struct.unpack(">I", buf)
+    body = b""
+    while len(body) < length:
+        chunk = sock.recv(length - len(body))
+        if not chunk:
+            raise ConnectionError("connection closed mid-frame")
+        body += chunk
+    return json.loads(body.decode("utf-8"))
+
+
 def pack_frame(data: dict) -> bytes:
     body = json.dumps(data).encode("utf-8")
     return _LEN.pack(len(body)) + body
@@ -532,21 +554,30 @@ def run_server(host: str = "127.0.0.1", port: int = 7070,
                 f"broker's {queue.n_partitions}; drop --partitions "
                 "or match it"
             )
-        if data_dir is None and any(
+        import os as _os
+
+        fresh_state = data_dir is None or not _os.path.exists(
+            _os.path.join(data_dir, "layout.json")
+        )
+        if fresh_state and any(
             queue.committed(p) >= 0 for p in range(partitions)
         ):
             # the broker has committed progress but this consumer has
-            # no durable document state: resuming past the committed
-            # offsets would bring every document up silently EMPTY
+            # no prior document state (no --data-dir, or an empty
+            # one): resuming past the committed offsets would bring
+            # every document up silently EMPTY
             raise SystemExit(
                 "broker has committed offsets but this server has no "
-                "--data-dir: resuming would skip all applied history. "
-                "Point --data-dir at the original state (or a "
-                "replacement host's copy)."
+                "prior state: resuming would skip all applied "
+                "history. Point --data-dir at the original state (or "
+                "a replacement host's copy)."
             )
+    # the marker records WHICH KIND of queue (local file vs networked
+    # broker), not the broker's address — a respelled host or a
+    # re-launched broker port must not brick the data dir
     _check_durable_layout(
         data_dir, partitions,
-        queue_source=f"broker:{broker}" if broker else "local",
+        queue_source="broker" if broker else "local",
     )
     if partitions > 0:
         from .partitioning import PartitionedServer
