@@ -43,6 +43,11 @@ from ..service.ingress import document_message_to_json, pack_frame
 _LEN = struct.Struct(">I")
 
 
+# wire versions this driver speaks, newest first (the server echoes
+# the agreed one in "connected"; see ingress.WIRE_VERSIONS)
+WIRE_VERSIONS = ("1.0",)
+
+
 def build_connect_frame(document_id: str, client_id: str, mode: str,
                         tenant_id=None, token=None) -> dict:
     """The connect_document handshake frame — ONE definition so the
@@ -53,6 +58,7 @@ def build_connect_frame(document_id: str, client_id: str, mode: str,
         "document_id": document_id,
         "client_id": client_id,
         "mode": mode,
+        "versions": list(WIRE_VERSIONS),
     }
     if token is not None:
         frame["tenant_id"] = tenant_id

@@ -183,12 +183,19 @@ class RichTextEditor:
     def add_comment(self, start: int, end: int, text: str):
         """Anchor a comment to [start, end): endpoints slide with
         concurrent edits (the interval collection). Endpoint anchors
-        attach to characters, so ``end`` clamps inside the document."""
-        end = min(end, self.length - 1) if self.length else 0
+        attach to characters; a comment reaching the document end
+        anchors its end ON the last character with a +1 bias (same
+        trick as the end-of-document caret), so the final character is
+        never silently dropped from the range."""
+        end_bias = 0
+        if end >= self.length:
+            end = max(self.length - 1, 0)
+            end_bias = 1
         start = min(start, end)
         comments = self.string.get_interval_collection("comments")
         return comments.add(start, end, props={
             "author": self.user, "text": text,
+            "endBias": end_bias,
         })
 
     def comments(self) -> list[dict]:
@@ -198,9 +205,11 @@ class RichTextEditor:
             lo, hi = comments.endpoints(iv)
             if lo < 0:
                 continue  # both endpoints collapsed away
+            props = dict(iv.props or {})
+            hi += props.pop("endBias", 0)
             out.append({
                 "id": iv.interval_id, "start": lo, "end": hi,
-                **{k: v for k, v in (iv.props or {}).items()},
+                **props,
             })
         return sorted(out, key=lambda c: (c["start"], c["id"]))
 

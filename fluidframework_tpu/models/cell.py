@@ -44,6 +44,16 @@ class SharedCell(SharedObject, EventEmitter):
 
     # ---- SharedObject contract
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate: re-author the set/delete as the
+        pending local value (sharedObject.ts:510)."""
+        if contents["type"] == "set":
+            self._value = contents["value"]
+        else:
+            self._value = _EMPTY
+        self._pending += 1
+        return None
+
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
         op = msg.contents

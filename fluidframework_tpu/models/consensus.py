@@ -68,6 +68,14 @@ class ConsensusRegisterCollection(SharedObject, EventEmitter):
 
     # ---- SharedObject contract
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate: consensus ops carry no optimistic
+        local state (their effect lands only when SEQUENCED —
+        consensus-register-collection's round-trip contract), so the
+        stashed op simply resubmits verbatim. Completion callbacks do
+        not survive a restart; the write still resolves."""
+        return None
+
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
         op = msg.contents
@@ -169,6 +177,14 @@ class ConsensusOrderedCollection(SharedObject, EventEmitter):
             self.emit("localRelease", aid, lease["value"])
 
     # ---- SharedObject contract
+
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate: consensus ops carry no optimistic
+        local state (their effect lands only when SEQUENCED —
+        consensus-register-collection's round-trip contract), so the
+        stashed op simply resubmits verbatim. Completion callbacks do
+        not survive a restart; the write still resolves."""
+        return None
 
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:

@@ -31,6 +31,12 @@ class SharedCounter(SharedObject, EventEmitter):
 
     # ---- SharedObject contract
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate: re-apply the increment
+        optimistically (it resubmits as a pending op)."""
+        self.value += contents["increment"]
+        return None
+
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
         if local:

@@ -70,6 +70,13 @@ class Ink(SharedObject, EventEmitter):
 
     # ---- SharedObject contract
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate: re-apply the stroke op locally and
+        queue it pending (same bookkeeping as the live edit path)."""
+        self._apply(contents)
+        self._pending.append({"op": contents, "wiped": False})
+        return None
+
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
         op = msg.contents

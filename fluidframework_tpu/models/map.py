@@ -238,6 +238,31 @@ class SharedDirectory(SharedObject, EventEmitter):
 
     # ---- SharedObject contract
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate: replay the directory op as pending
+        local state (directory.ts applyStashedOp)."""
+        kind = contents["type"]
+        if kind == "createSubdir":
+            sub = contents["path"]
+            self._nodes.setdefault(sub, MapKernel())
+            self._pending_subdirs[sub] = \
+                self._pending_subdirs.get(sub, 0) + 1
+        elif kind == "deleteSubdir":
+            sub = contents["path"]
+            self._drop_subtree(sub)
+            self._pending_subdirs[sub] = \
+                self._pending_subdirs.get(sub, 0) + 1
+        else:
+            node = self._nodes.setdefault(
+                contents.get("path", "/"), MapKernel())
+            if kind == "set":
+                node.set_local(contents["key"], contents["value"])
+            elif kind == "delete":
+                node.delete_local(contents["key"])
+            else:
+                raise ValueError(f"unknown stashed dir op {kind!r}")
+        return None
+
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
         op = msg.contents

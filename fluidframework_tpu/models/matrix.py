@@ -109,6 +109,24 @@ class SharedMatrix(SharedObject, EventEmitter):
     # ------------------------------------------------------------------
     # SharedObject contract
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate: re-author axis merge-tree ops and
+        cell LWW writes as pending local state (matrix.ts
+        applyStashedOp)."""
+        target = contents["target"]
+        if target in ("rows", "cols"):
+            axis = self.rows if target == "rows" else self.cols
+            if not axis.mergetree.collab.collaborating:
+                axis.start_collaboration(
+                    self.client_id or "\x00detached")
+            axis._apply_local(contents["op"])
+            return None
+        assert target == "cell"
+        key = (contents["row"], contents["col"])
+        self._cells[key] = contents["value"]
+        self._pending_cells[key] = self._pending_cells.get(key, 0) + 1
+        return None
+
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
         # see SharedString.process_core: load-time catch-up must apply

@@ -45,6 +45,12 @@ class SharedQuorum(SharedObject, EventEmitter):
 
     # ---- SharedObject contract
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate: quorum sets have no optimistic
+        local state (values become pending only when SEQUENCED), so
+        the stashed op just resubmits verbatim."""
+        return None
+
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_op_metadata: Any = None) -> None:
         op = msg.contents

@@ -171,7 +171,17 @@ class SharedString(SharedObject, EventEmitter):
     def apply_stashed_op(self, contents: Any) -> Any:
         """Offline-stash rehydrate (client.ts:894 applyStashedOp):
         re-author the stashed op as pending local state; reconnect
-        then regenerates and resubmits it rebased."""
+        then regenerates and resubmits it rebased.
+
+        Collaboration MUST be active first: a non-collab _apply_local
+        lands as universal (non-pending) state, so the op would look
+        applied locally yet never resubmit — silent permanent
+        divergence (found by the all-channel stash-cycle test; only
+        bites documents whose string had no sequenced ops yet)."""
+        if not self.client.mergetree.collab.collaborating:
+            self.client.start_collaboration(
+                self.client_id or "\x00detached"
+            )
         if isinstance(contents, IntervalOp):
             coll = self.get_interval_collection(contents.label)
             return coll.apply_stashed_op(contents) \

@@ -40,6 +40,11 @@ class SharedSummaryBlock(SharedObject):
                      local_op_metadata: Any = None) -> None:
         raise AssertionError("SharedSummaryBlock receives no ops")
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        raise AssertionError(
+            "SharedSummaryBlock receives no ops (write-once pre-attach)"
+        )
+
     def summarize_core(self) -> dict:
         return {"data": dict(self._data)}
 

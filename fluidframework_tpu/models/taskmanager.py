@@ -45,6 +45,15 @@ class TaskManager(SharedObject, EventEmitter):
         self._pending_abandons.add(task_id)
         self.submit_local_message({"type": "abandon", "taskId": task_id})
 
+    def apply_stashed_op(self, contents: Any) -> Any:
+        """Offline-stash rehydrate: restore the in-flight intent sets
+        (queue membership only changes when ops SEQUENCE)."""
+        if contents["type"] == "volunteer":
+            self._pending_volunteers.add(contents["taskId"])
+        else:
+            self._pending_abandons.add(contents["taskId"])
+        return None
+
     def assigned(self, task_id: str) -> str | None:
         """Current assignee (queue head) or None."""
         queue = self._queues.get(task_id)

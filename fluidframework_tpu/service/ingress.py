@@ -61,6 +61,15 @@ from .local_server import DeltaConnection, LocalServer
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
 
+# Wire-protocol versions this server speaks (newest first). The
+# reference negotiates `versions` on connect_document
+# (documentDeltaConnection.ts protocolVersions / alfred's
+# connect_document): the client offers what it speaks, the server
+# picks the newest shared one and echoes it in "connected"; no overlap
+# is a connect error, not a silent mismatch. Snapshot formats are
+# versioned separately (testing/compat.py); this covers the FRAMES.
+WIRE_VERSIONS = ("1.0",)
+
 
 def document_message_to_json(op: DocumentMessage) -> dict:
     return {
@@ -116,15 +125,17 @@ def recv_frame_blocking(sock) -> dict:
     """Read one frame from a BLOCKING socket — the sync-side twin of
     ``read_frame`` (one definition of the wire framing for clients
     without an event loop, e.g. the broker's request/response
-    client)."""
+    client). Enforces the same MAX_FRAME bound: a corrupt/desynced
+    length prefix must fail fast, not allocate gigabytes."""
     buf = b""
-    need = 4
-    while len(buf) < need:
-        chunk = sock.recv(need - len(buf))
+    while len(buf) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(buf))
         if not chunk:
             raise ConnectionError("connection closed")
         buf += chunk
-    (length,) = struct.unpack(">I", buf)
+    (length,) = _LEN.unpack(buf)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame length {length} exceeds {MAX_FRAME}")
     body = b""
     while len(body) < length:
         chunk = sock.recv(length - len(body))
@@ -298,6 +309,22 @@ class AlfredServer:
         if kind == "connect_document":
             client_id = frame["client_id"]
             details = frame.get("details") or {}
+            # wire-version negotiation: pick the newest shared version
+            # (clients predating the field implicitly offer 1.0)
+            offered = frame.get("versions") or ["1.0"]
+            agreed = next(
+                (v for v in WIRE_VERSIONS if v in offered), None
+            )
+            if agreed is None:
+                session.send({
+                    "type": "connect_document_error",
+                    "document_id": doc,
+                    "message": (
+                        f"no common wire version: client {offered}, "
+                        f"server {list(WIRE_VERSIONS)}"
+                    ),
+                })
+                return
             # "read" connections subscribe without joining the quorum
             # (alfred gates the required scope by requested mode)
             mode = frame.get("mode", "write")
@@ -345,7 +372,7 @@ class AlfredServer:
                 session.write_authorized.add(doc)
             session.send({
                 "type": "connected", "document_id": doc,
-                "client_id": client_id,
+                "client_id": client_id, "version": agreed,
             })
         elif kind == "submitOp":
             conn = session.connections[doc]
@@ -500,9 +527,13 @@ def _check_durable_layout(data_dir: Optional[str],
     if _os.path.exists(marker):
         with open(marker) as f:
             stored = _json.load(f)
-        # pre-queue-field markers: local was the only option then
+        # pre-queue-field markers: local was the only option then;
+        # early markers stored the broker ADDRESS — normalize to the
+        # kind (an address respelling must not brick the dir)
         if stored.get("mode") == "partitioned":
             stored.setdefault("queue", "local")
+            if str(stored["queue"]).startswith("broker:"):
+                stored["queue"] = "broker"
         if stored != current:
             raise SystemExit(
                 f"data dir {data_dir!r} was created with layout "

@@ -265,3 +265,16 @@ def test_toggle_mark_across_paragraph_boundary_clears():
     assert all(
         "bold" not in m for p in eb.render() for _, m in p.runs
     )
+
+
+def test_comment_to_document_end_keeps_last_char():
+    """A comment ending at the document end must cover the final
+    character (end anchors on the last char with +1 bias — the clamp
+    used to silently shorten the range)."""
+    _, (ca, ea), (cb, eb) = make_pair()
+    ea.type_text("note the last word")
+    i = ea.plain_text().index("word")
+    ea.add_comment(i, ea.length, "on the last word")
+    ca.flush()
+    c = eb.comments()[0]
+    assert eb.text_span(c["start"], c["end"]) == "word"

@@ -419,3 +419,122 @@ def test_loader_reads_storage_with_token_before_connect(
         assert got.get_text() == "authed"
     svc.close()
     svc2.close()
+
+
+# ---- foreman: task routing to agent workers ---------------------------
+
+def _help_msg(seq, tasks):
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+    from fluidframework_tpu.service.foreman import help_envelope
+
+    return SequencedMessage(
+        client_id="runtime", sequence_number=seq,
+        minimum_sequence_number=0, client_sequence_number=seq,
+        reference_sequence_number=0, type=MessageType.OPERATION,
+        contents=help_envelope(tasks),
+    )
+
+
+def test_foreman_routes_least_loaded_and_reroutes_on_leave():
+    from fluidframework_tpu.service.foreman import ForemanLambda
+
+    ran = []
+    fm = ForemanLambda()
+    fm.register_agent("spell-1", {"spell"},
+                      run=lambda t, m: ran.append(("spell-1", t)))
+    fm.register_agent("spell-2", {"spell"},
+                      run=lambda t, m: ran.append(("spell-2", t)))
+    fm.register_agent("intel", {"translate", "*"},
+                      run=lambda t, m: ran.append(("intel", t)))
+    fm.handler(_help_msg(1, ["spell:doc1", "translate:doc1"]))
+    # no capability match for 'spell:doc1' string: capabilities match
+    # by task name
+    fm2 = ForemanLambda()
+    fm2.register_agent("a", {"spell"},
+                       run=lambda t, m: ran.append(("a", t)))
+    fm2.register_agent("b", {"spell"},
+                       run=lambda t, m: ran.append(("b", t)))
+    fm2.handler(_help_msg(1, ["spell"]))
+    assert fm2.assignments["spell"] == "a"       # tiebreak by name
+    fm2.handler(_help_msg(2, ["spell"]))         # duplicate: no-op
+    assert fm2.agent_load("a") == 1 and fm2.agent_load("b") == 0
+    # agent leaves: its task reroutes to the survivor
+    fm2.unregister_agent("a")
+    assert fm2.assignments["spell"] == "b"
+    # completion frees the slot
+    fm2.complete("spell")
+    assert fm2.agent_load("b") == 0
+    assert "spell" not in fm2.assignments
+
+
+def test_foreman_queues_until_capable_agent_registers():
+    from fluidframework_tpu.service.foreman import ForemanLambda
+
+    fm = ForemanLambda()
+    fm.handler(_help_msg(1, ["snapshot"]))
+    assert fm.unassigned and not fm.assignments
+    ran = []
+    fm.register_agent("snapper", {"snapshot"},
+                      run=lambda t, m: ran.append(t))
+    assert fm.assignments["snapshot"] == "snapper"
+    assert ran == ["snapshot"]
+    assert not fm.unassigned
+
+
+def test_wire_version_negotiation(alfred_on_thread):
+    """connect_document negotiates the newest shared wire version;
+    disjoint offers are a connect error, not a silent mismatch."""
+    import asyncio
+
+    from fluidframework_tpu.service.ingress import (
+        AlfredServer,
+        pack_frame,
+        read_frame,
+    )
+
+    async def scenario():
+        server = AlfredServer()
+        await server.start()
+        r, w = await asyncio.open_connection("127.0.0.1", server.port)
+        # current client
+        w.write(pack_frame({
+            "type": "connect_document", "document_id": "d",
+            "client_id": "a", "versions": ["2.0", "1.0"],
+        }))
+        await w.drain()
+        while True:
+            resp = await read_frame(r)
+            if resp["type"] in ("connected", "connect_document_error"):
+                break
+        assert resp["type"] == "connected"
+        assert resp["version"] == "1.0"
+        # future-only client: refused loudly
+        w.write(pack_frame({
+            "type": "connect_document", "document_id": "d2",
+            "client_id": "a", "versions": ["9.9"],
+        }))
+        await w.drain()
+        while True:
+            resp = await read_frame(r)
+            if resp["type"] in ("connected", "connect_document_error"):
+                break
+        assert resp["type"] == "connect_document_error"
+        assert "no common wire version" in resp["message"]
+        # legacy client with no field: implicit 1.0
+        w.write(pack_frame({
+            "type": "connect_document", "document_id": "d3",
+            "client_id": "a",
+        }))
+        await w.drain()
+        while True:
+            resp = await read_frame(r)
+            if resp["type"] in ("connected", "connect_document_error"):
+                break
+        assert resp["type"] == "connected"
+        w.close()
+        await server.stop()
+
+    asyncio.run(scenario())
