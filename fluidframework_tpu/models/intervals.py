@@ -36,6 +36,32 @@ if TYPE_CHECKING:  # pragma: no cover
 
 ENDPOINT_REF_TYPE = ReferenceType.SLIDE_ON_REMOVE
 
+# Endpoint stickiness (the reference's IntervalStickiness,
+# intervalCollection.ts side/stickiness machinery): whether text
+# inserted exactly AT a boundary joins the interval. Implemented with
+# SIDE-AWARE anchors — a sequenced insert lands BEFORE the slot at its
+# position, so which character an endpoint anchors, and on which side
+# (ReferenceType.AFTER = the position following the char, collapsing
+# backward when the char is removed), decides boundary membership:
+#   start non-sticky: anchor ON the first contained char (boundary
+#     inserts push it right -> stay outside);
+#   start sticky:     anchor AFTER the char preceding the interval
+#     (boundary inserts land beyond that char -> inside); at position
+#     0 the sentinel DOC_START pins the boundary to 0 forever;
+#   end sticky:       anchor ON the char at the exclusive bound
+#     (boundary inserts land before it -> inside); at document end
+#     the sentinel DOC_END tracks the live length (appends join);
+#   end non-sticky:   anchor AFTER the last contained char (boundary
+#     inserts fall beyond the resolved position -> outside; removing
+#     that char collapses the end backward, never absorbing text).
+STICKY_END = "end"      # the reference's default
+STICKY_START = "start"
+STICKY_FULL = "full"
+STICKY_NONE = "none"
+_STICKINESS = (STICKY_END, STICKY_START, STICKY_FULL, STICKY_NONE)
+_DOC_START = "<doc-start>"
+_DOC_END = "<doc-end>"
+
 
 @dataclass
 class IntervalOp:
@@ -48,19 +74,23 @@ class IntervalOp:
     start: Optional[int] = None    # sender-view positions
     end: Optional[int] = None
     props: Optional[dict] = None
+    stickiness: Optional[str] = None  # add only; None = "end"
 
 
 class SequenceInterval:
     """A live interval: two sliding endpoint references + properties."""
 
     __slots__ = ("interval_id", "start_ref", "end_ref", "props",
-                 "change_seq", "pending_endpoints", "pending_props")
+                 "change_seq", "pending_endpoints", "pending_props",
+                 "stickiness")
 
     def __init__(self, interval_id: str, start_ref, end_ref,
-                 props: Optional[dict] = None):
+                 props: Optional[dict] = None,
+                 stickiness: str = STICKY_END):
         self.interval_id = interval_id
-        self.start_ref = start_ref
-        self.end_ref = end_ref
+        self.start_ref = start_ref     # LocalReference | _DOC_START
+        self.end_ref = end_ref         # LocalReference | _DOC_END
+        self.stickiness = stickiness
         self.props: dict = dict(props) if props else {}
         # seq that last changed this interval (LWW ordering); 0 = not
         # yet sequenced (pending local add)
@@ -102,11 +132,18 @@ class IntervalCollection:
         return self._intervals.get(interval_id)
 
     def endpoints(self, interval: SequenceInterval) -> tuple[int, int]:
-        """Current (start, end) positions after sliding."""
-        return (
-            self._client.reference_position(interval.start_ref),
-            self._client.reference_position(interval.end_ref),
-        )
+        """Current (start, end) positions after sliding (start
+        inclusive, end exclusive; stickiness decides boundary
+        membership — see _make)."""
+        if interval.start_ref == _DOC_START:
+            start = 0
+        else:
+            start = self._client.reference_position(interval.start_ref)
+        if interval.end_ref == _DOC_END:
+            end = self._client.get_length()
+        else:
+            end = self._client.reference_position(interval.end_ref)
+        return start, end
 
     def find_overlapping(self, start: int, end: int
                          ) -> list[SequenceInterval]:
@@ -128,11 +165,13 @@ class IntervalCollection:
     # local edits
 
     def add(self, start: int, end: int,
-            props: Optional[dict] = None) -> SequenceInterval:
+            props: Optional[dict] = None,
+            stickiness: str = STICKY_END) -> SequenceInterval:
         # uuid ids like the reference: creator-unique without any
         # counter state to restore on summary load
         interval_id = uuid.uuid4().hex
-        interval = self._make(interval_id, start, end, props)
+        interval = self._make(interval_id, start, end, props,
+                              stickiness=stickiness)
         interval.pending_endpoints += 1
         for k in (props or {}):
             interval.pending_props[k] = interval.pending_props.get(k, 0) + 1
@@ -140,6 +179,8 @@ class IntervalCollection:
         self._submit(IntervalOp(
             label=self.label, action="add", interval_id=interval_id,
             start=start, end=end, props=dict(props) if props else None,
+            stickiness=None if stickiness == STICKY_END
+            else stickiness,
         ))
         return interval
 
@@ -161,14 +202,14 @@ class IntervalCollection:
         if interval is None:
             raise KeyError(interval_id)
         if start is not None:
-            detach_reference(interval.start_ref)
-            interval.start_ref = self._client.create_reference(
-                start, ENDPOINT_REF_TYPE
+            self._drop_ref(interval.start_ref)
+            interval.start_ref = self._start_ref(
+                start, interval.stickiness
             )
         if end is not None:
-            detach_reference(interval.end_ref)
-            interval.end_ref = self._client.create_reference(
-                end, ENDPOINT_REF_TYPE
+            self._drop_ref(interval.end_ref)
+            interval.end_ref = self._end_ref(
+                end, interval.stickiness
             )
         if props:
             interval.props.update(
@@ -202,7 +243,8 @@ class IntervalCollection:
             if old is not None:
                 self._drop_refs(old)
             interval = self._make(
-                op.interval_id, op.start, op.end, op.props, view_of=msg
+                op.interval_id, op.start, op.end, op.props,
+                view_of=msg, stickiness=op.stickiness or STICKY_END,
             )
             interval.change_seq = msg.sequence_number
             self._intervals[op.interval_id] = interval
@@ -223,14 +265,14 @@ class IntervalCollection:
             # to pending local values (PropertiesManager discipline)
             if interval.pending_endpoints == 0:
                 if op.start is not None:
-                    detach_reference(interval.start_ref)
-                    interval.start_ref = self._client.create_reference(
-                        op.start, ENDPOINT_REF_TYPE, view_of=msg
+                    self._drop_ref(interval.start_ref)
+                    interval.start_ref = self._start_ref(
+                        op.start, interval.stickiness, view_of=msg
                     )
                 if op.end is not None:
-                    detach_reference(interval.end_ref)
-                    interval.end_ref = self._client.create_reference(
-                        op.end, ENDPOINT_REF_TYPE, view_of=msg
+                    self._drop_ref(interval.end_ref)
+                    interval.end_ref = self._end_ref(
+                        op.end, interval.stickiness, view_of=msg
                     )
             if op.props:
                 for k, v in op.props.items():
@@ -299,6 +341,9 @@ class IntervalCollection:
                     interval_id=interval.interval_id,
                     start=start, end=end,
                     props=dict(interval.props) or None,
+                    stickiness=None
+                    if interval.stickiness == STICKY_END
+                    else interval.stickiness,
                 ))
                 interval.pending_endpoints = 1
                 interval.pending_props = {k: 1 for k in interval.props}
@@ -333,12 +378,15 @@ class IntervalCollection:
             start, end = self.endpoints(interval)
             if start == DETACHED_POSITION or end == DETACHED_POSITION:
                 continue  # anchored content is gone; nothing to restore
-            out.append({
+            entry = {
                 "id": interval.interval_id,
                 "start": start,
                 "end": end,
                 "props": interval.props or None,
-            })
+            }
+            if interval.stickiness != STICKY_END:
+                entry["stickiness"] = interval.stickiness
+            out.append(entry)
         return out
 
     def load(self, entries: list[dict]) -> None:
@@ -346,31 +394,60 @@ class IntervalCollection:
             if entry["start"] < 0 or entry["end"] < 0:
                 continue  # detached in the summary writer's view
             interval = self._make(
-                entry["id"], entry["start"], entry["end"], entry["props"]
+                entry["id"], entry["start"], entry["end"],
+                entry["props"],
+                stickiness=entry.get("stickiness", STICKY_END),
             )
             self._intervals[entry["id"]] = interval
 
     # ------------------------------------------------------------------
 
-    def _make(self, interval_id: str, start: int, end: int,
-              props: Optional[dict],
-              view_of: Optional["SequencedMessage"] = None
-              ) -> SequenceInterval:
-        return SequenceInterval(
-            interval_id,
-            self._client.create_reference(
-                start, ENDPOINT_REF_TYPE, view_of=view_of
-            ),
-            self._client.create_reference(
-                end, ENDPOINT_REF_TYPE, view_of=view_of
-            ),
-            props,
-        )
+    def _start_ref(self, start: int, stickiness: str,
+                   view_of: Optional["SequencedMessage"] = None):
+        if stickiness in (STICKY_START, STICKY_FULL):
+            if start == 0:
+                return _DOC_START
+            return self._client.create_reference(
+                start - 1, ENDPOINT_REF_TYPE | ReferenceType.AFTER,
+                view_of=view_of)
+        return self._client.create_reference(
+            start, ENDPOINT_REF_TYPE, view_of=view_of)
+
+    def _end_ref(self, end: int, stickiness: str,
+                 view_of: Optional["SequencedMessage"] = None):
+        if stickiness in (STICKY_END, STICKY_FULL):
+            if end >= self._client.length_in_view(view_of):
+                return _DOC_END
+            return self._client.create_reference(
+                end, ENDPOINT_REF_TYPE, view_of=view_of)
+        if end == 0:
+            return _DOC_START
+        return self._client.create_reference(
+            end - 1, ENDPOINT_REF_TYPE | ReferenceType.AFTER,
+            view_of=view_of)
 
     @staticmethod
-    def _drop_refs(interval: SequenceInterval) -> None:
-        detach_reference(interval.start_ref)
-        detach_reference(interval.end_ref)
+    def _drop_ref(ref) -> None:
+        if ref not in (_DOC_START, _DOC_END):
+            detach_reference(ref)
+
+    def _make(self, interval_id: str, start: int, end: int,
+              props: Optional[dict],
+              view_of: Optional["SequencedMessage"] = None,
+              stickiness: str = STICKY_END) -> SequenceInterval:
+        if stickiness not in _STICKINESS:
+            raise ValueError(f"unknown stickiness {stickiness!r}")
+        return SequenceInterval(
+            interval_id,
+            self._start_ref(start, stickiness, view_of),
+            self._end_ref(end, stickiness, view_of),
+            props, stickiness,
+        )
+
+    @classmethod
+    def _drop_refs(cls, interval: SequenceInterval) -> None:
+        cls._drop_ref(interval.start_ref)
+        cls._drop_ref(interval.end_ref)
 
     # ------------------------------------------------------------------
 

@@ -364,6 +364,19 @@ class MergeTreeClient:
     def reference_position(self, ref) -> int:
         return self.mergetree.reference_position(ref)
 
+    def length_in_view(
+        self, view_of: Optional[SequencedMessage] = None
+    ) -> int:
+        """Visible length at a message's (refSeq, sender) view — the
+        coordinate space its positions live in (current view when
+        None)."""
+        if view_of is None:
+            return self.mergetree.length_at()
+        return self.mergetree.length_at(
+            view_of.reference_sequence_number,
+            self.intern(view_of.client_id),
+        )
+
     # ------------------------------------------------------------------
     # queries
 

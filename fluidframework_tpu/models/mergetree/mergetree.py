@@ -584,12 +584,24 @@ class MergeTree:
 
     def reference_position(self, ref: LocalReference) -> int:
         """Current document position of a local reference, applying
-        slide-on-remove resolution (localReferencePositionToPosition)."""
+        slide-on-remove resolution (localReferencePositionToPosition).
+        AFTER references resolve to the position following their
+        character, collapsing BACKWARD (not sliding forward) when that
+        character is removed — side-aware endpoints for sticky
+        intervals."""
         seg = ref.segment
         if seg is None:
             return DETACHED_POSITION
         cur = self.collab.current_seq
         viewer = self.collab.client_id
+        if ref.ref_type & ReferenceType.AFTER:
+            try:
+                base = self.get_offset(seg, cur, viewer)
+            except ValueError:
+                return DETACHED_POSITION  # orphaned anchor
+            if self._length_at(seg, cur, viewer):
+                return base + ref.offset + 1
+            return base  # anchor char gone: collapse to the boundary
         length = self._length_at(seg, cur, viewer)
         if length:
             try:

@@ -28,6 +28,13 @@ class ReferenceType(IntEnum):
     SLIDE_ON_REMOVE = 0x40
     STAY_ON_REMOVE = 0x80
     TRANSIENT = 0x100
+    # side-aware anchor: the reference denotes the position AFTER its
+    # character. Inserts at that position land before the NEXT char, so
+    # they fall on the far side of the boundary; when the anchor char
+    # is removed the position collapses BACKWARD to where it was (no
+    # forward slide) — the resolution sticky interval endpoints need
+    # (sequence Side/stickiness machinery in the reference)
+    AFTER = 0x200
 
 
 @dataclass

@@ -377,3 +377,158 @@ def test_interval_reconnect_props_only_change_keeps_remote_endpoints():
         assert got.props == {"x": 2}, ss
         assert coll.endpoints(got) == (6, 10), ss
     assert sa.signature() == sb.signature()
+
+
+# ---- endpoint stickiness (intervalCollection.ts IntervalStickiness) --
+
+def _sticky_coll(stickiness, text="abcdef"):
+    from fluidframework_tpu.models.intervals import IntervalCollection
+
+    c = make_client(text)
+    coll = IntervalCollection("x", c, lambda op: None)
+    iv = coll.add(2, 4, stickiness=stickiness)  # "cd"
+    return c, coll, iv
+
+
+def test_stickiness_end_default_boundary_inserts():
+    """Default (end-sticky): text at the END boundary joins, text at
+    the START boundary stays out."""
+    c, coll, iv = _sticky_coll("end")
+    c.insert_text_local(4, "XY")          # end boundary
+    lo, hi = coll.endpoints(iv)
+    assert c.get_text()[lo:hi] == "cdXY"
+    c.insert_text_local(2, "Z")           # start boundary
+    lo, hi = coll.endpoints(iv)
+    assert c.get_text()[lo:hi] == "cdXY"  # Z stayed outside
+
+
+def test_stickiness_none_boundary_inserts_stay_out():
+    c, coll, iv = _sticky_coll("none")
+    c.insert_text_local(4, "XY")
+    lo, hi = coll.endpoints(iv)
+    assert c.get_text()[lo:hi] == "cd"
+    c.insert_text_local(2, "Z")
+    lo, hi = coll.endpoints(iv)
+    assert c.get_text()[lo:hi] == "cd"
+
+
+def test_stickiness_full_absorbs_both_boundaries():
+    c, coll, iv = _sticky_coll("full")
+    c.insert_text_local(4, "XY")
+    c.insert_text_local(2, "Z")
+    lo, hi = coll.endpoints(iv)
+    assert c.get_text()[lo:hi] == "ZcdXY"
+
+
+def test_stickiness_full_at_document_edges():
+    """Sticky start at 0 stays 0; sticky end at the document end
+    tracks appends."""
+    from fluidframework_tpu.models.intervals import IntervalCollection
+
+    c = make_client("abcdef")
+    coll = IntervalCollection("x", c, lambda op: None)
+    iv = coll.add(0, c.get_length(), stickiness="full")
+    c.insert_text_local(0, ">>")
+    c.insert_text_local(c.get_length(), "<<")
+    lo, hi = coll.endpoints(iv)
+    assert (lo, hi) == (0, c.get_length())
+
+
+def test_stickiness_replicates_to_remote():
+    """The add op carries stickiness; a remote replica anchors the
+    same way and boundary inserts converge."""
+    cs = ContainerSession(["A", "B"])
+    for cid in ("A", "B"):
+        cs.runtime(cid).create_datastore("d").create_channel(
+            "sharedstring", "t")
+    ta = cs.runtime("A").get_datastore("d").get_channel("t")
+    tb = cs.runtime("B").get_datastore("d").get_channel("t")
+    ta.insert_text(0, "abcdef")
+    cs.process_all()
+    ia = ta.get_interval_collection("c")
+    ia.add(2, 4, stickiness="full")
+    cs.process_all()
+    tb.insert_text(4, "XY")
+    tb.insert_text(2, "Z")
+    cs.process_all()
+    ib = tb.get_interval_collection("c")
+    assert ia.signature() == ib.signature()
+    iv_b = next(iter(ib))
+    lo, hi = ib.endpoints(iv_b)
+    assert tb.get_text()[lo:hi] == "ZcdXY"
+
+
+def test_stickiness_survives_summary_roundtrip():
+    from fluidframework_tpu.models.intervals import IntervalCollection
+
+    c, coll, iv = _sticky_coll("full")
+    entries = coll.summarize()
+    assert entries[0]["stickiness"] == "full"
+    c2 = make_client("abcdef")
+    coll2 = IntervalCollection("x", c2, lambda op: None)
+    coll2.load(entries)
+    c2.insert_text_local(4, "XY")
+    iv2 = next(iter(coll2))
+    lo, hi = coll2.endpoints(iv2)
+    assert c2.get_text()[lo:hi] == "cdXY"
+
+
+def test_stickiness_anchor_removal_collapses_not_slides():
+    """Removing an endpoint's anchor character must collapse the
+    boundary backward, not slide it forward (code-review r4: the
+    +1-bias representation absorbed/dropped a character here; the
+    side-aware AFTER reference fixes it)."""
+    from fluidframework_tpu.models.intervals import IntervalCollection
+
+    c = make_client("abcdef")
+    coll = IntervalCollection("x", c, lambda op: None)
+    iv = coll.add(2, 4, stickiness="full")   # "cd"
+    c.remove_range_local(1, 2)               # remove the start anchor
+    lo, hi = coll.endpoints(iv)
+    assert c.get_text()[lo:hi] == "cd"
+
+    c2 = make_client("abcdef")
+    coll2 = IntervalCollection("x", c2, lambda op: None)
+    iv2 = coll2.add(2, 4, stickiness="none")  # "cd"
+    c2.remove_range_local(3, 4)               # remove the end anchor
+    lo, hi = coll2.endpoints(iv2)
+    assert c2.get_text()[lo:hi] == "c"        # no absorb of 'e'
+
+
+def test_empty_interval_with_nonsticky_end_stays_empty():
+    from fluidframework_tpu.models.intervals import IntervalCollection
+
+    c = make_client("abcdef")
+    coll = IntervalCollection("x", c, lambda op: None)
+    iv = coll.add(2, 2, stickiness="none")
+    assert coll.endpoints(iv) == (2, 2)
+
+
+def test_sticky_change_local_and_remote_partial():
+    """change() on sticky intervals is sentinel-safe and exact; a
+    remote PARTIAL change leaves the untouched endpoint's anchor alone
+    (re-deriving it through the sender's older view diverged
+    replicas — code-review r4)."""
+    cs = ContainerSession(["A", "B"])
+    for cid in ("A", "B"):
+        cs.runtime(cid).create_datastore("d").create_channel(
+            "sharedstring", "t")
+    ta = cs.runtime("A").get_datastore("d").get_channel("t")
+    tb = cs.runtime("B").get_datastore("d").get_channel("t")
+    ta.insert_text(0, "abcdef")
+    cs.process_all()
+    ia = ta.get_interval_collection("c")
+    iv = ia.add(2, 4, stickiness="full")
+    cs.process_all()
+    # concurrent: A inserts at the front while B changes ONLY start
+    ib = tb.get_interval_collection("c")
+    iv_b = next(iter(ib))
+    ta.insert_text(0, "XX")
+    ib.change(iv_b.interval_id, start=3)
+    cs.process_all()
+    assert ia.signature() == ib.signature()
+    # local sticky change on sentinel endpoints doesn't crash
+    iv0 = ia.add(0, 3, stickiness="full")
+    ia.change(iv0.interval_id, start=1)
+    cs.process_all()
+    assert ia.signature() == ib.signature()
