@@ -63,6 +63,11 @@ _DOC_START = "<doc-start>"
 _DOC_END = "<doc-end>"
 
 
+def _wire_stickiness(stickiness: str) -> Optional[str]:
+    """Default-elided wire form (the add op omits the default)."""
+    return None if stickiness == STICKY_END else stickiness
+
+
 @dataclass
 class IntervalOp:
     """The nested interval op carried inside the sequence channel
@@ -135,15 +140,14 @@ class IntervalCollection:
         """Current (start, end) positions after sliding (start
         inclusive, end exclusive; stickiness decides boundary
         membership — see _make)."""
-        if interval.start_ref == _DOC_START:
-            start = 0
-        else:
-            start = self._client.reference_position(interval.start_ref)
-        if interval.end_ref == _DOC_END:
-            end = self._client.get_length()
-        else:
-            end = self._client.reference_position(interval.end_ref)
-        return start, end
+        def resolve(ref):
+            if ref == _DOC_START:
+                return 0
+            if ref == _DOC_END:
+                return self._client.get_length()
+            return self._client.reference_position(ref)
+
+        return resolve(interval.start_ref), resolve(interval.end_ref)
 
     def find_overlapping(self, start: int, end: int
                          ) -> list[SequenceInterval]:
@@ -179,8 +183,7 @@ class IntervalCollection:
         self._submit(IntervalOp(
             label=self.label, action="add", interval_id=interval_id,
             start=start, end=end, props=dict(props) if props else None,
-            stickiness=None if stickiness == STICKY_END
-            else stickiness,
+            stickiness=_wire_stickiness(stickiness),
         ))
         return interval
 
@@ -341,9 +344,7 @@ class IntervalCollection:
                     interval_id=interval.interval_id,
                     start=start, end=end,
                     props=dict(interval.props) or None,
-                    stickiness=None
-                    if interval.stickiness == STICKY_END
-                    else interval.stickiness,
+                    stickiness=_wire_stickiness(interval.stickiness),
                 ))
                 interval.pending_endpoints = 1
                 interval.pending_props = {k: 1 for k in interval.props}

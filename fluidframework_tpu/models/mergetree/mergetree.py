@@ -389,19 +389,43 @@ class MergeTree:
         for i, seg in enumerate(segs):
             if not dropped[i] or not seg.local_refs:
                 continue
-            target: Optional[Segment] = None
-            t_off = 0
-            for j in range(i + 1, len(segs)):  # forward slide first
+            fwd: Optional[Segment] = None
+            for j in range(i + 1, len(segs)):  # next survivor
                 if not dropped[j]:
-                    target, t_off = segs[j], 0
+                    fwd = segs[j]
                     break
-            if target is None:
-                for j in range(i - 1, -1, -1):  # then backward
-                    if not dropped[j]:
-                        target = segs[j]
-                        t_off = max(target.length - 1, 0)
-                        break
+            bwd: Optional[Segment] = None
+            for j in range(i - 1, -1, -1):     # previous survivor
+                if not dropped[j]:
+                    bwd = segs[j]
+                    break
             for ref in seg.local_refs:
+                # side-aware: AFTER refs collapsed BACKWARD when their
+                # char was removed (reference_position) — compaction
+                # must preserve that resolution, so they transfer to
+                # the previous survivor's last char; plain refs keep
+                # the forward-first slide
+                if ref.ref_type & ReferenceType.AFTER:
+                    target = bwd or None
+                    if target is not None:
+                        t_off = max(target.length - 1, 0)
+                    elif fwd is not None:
+                        # nothing before: the AFTER position collapsed
+                        # to 0 == "before the next survivor"; keep that
+                        # by anchoring the next survivor's first char
+                        # WITHOUT the after-bias — drop the AFTER flag
+                        target, t_off = fwd, 0
+                        ref.ref_type &= ~ReferenceType.AFTER
+                    else:
+                        target = None
+                else:
+                    if fwd is not None:
+                        target, t_off = fwd, 0
+                    elif bwd is not None:
+                        target = bwd
+                        t_off = max(target.length - 1, 0)
+                    else:
+                        target = None
                 if target is None:
                     ref.detach()
                 else:

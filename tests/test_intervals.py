@@ -532,3 +532,54 @@ def test_sticky_change_local_and_remote_partial():
     ia.change(iv0.interval_id, start=1)
     cs.process_all()
     assert ia.signature() == ib.signature()
+
+
+def test_stickiness_survives_zamboni_compaction():
+    """Compaction transfers AFTER refs BACKWARD (code-review r4: the
+    forward-first transfer made a collapsed endpoint jump forward one
+    character once min_seq passed the removal)."""
+    from fluidframework_tpu.models.intervals import IntervalCollection
+
+    s, clients = _mock_session(2)
+    a, b = clients
+    a.insert_text_local(0, "abcdef")
+    s.process_all()
+    coll = IntervalCollection("x", a, lambda op: None)
+    iv = coll.add(2, 4, stickiness="none")    # 'cd', end AFTER 'd'
+    ivf = coll.add(2, 4, stickiness="full")   # start AFTER 'b'
+    a.remove_range_local(3, 4)                # remove 'd'
+    a.remove_range_local(1, 2)                # remove 'b'
+    s.process_all()
+    lo, hi = coll.endpoints(iv)
+    assert a.get_text()[lo:hi] == "c"
+    lo_f, hi_f = coll.endpoints(ivf)
+    assert a.get_text()[lo_f:hi_f] == "c"
+    # advance min_seq well past the removals, forcing zamboni
+    for i in range(20):
+        a.insert_text_local(a.get_length(), "z")
+        s.process_all()
+    a.zamboni() if hasattr(a, "zamboni") else a.mergetree.zamboni()
+    lo, hi = coll.endpoints(iv)
+    assert a.get_text()[lo:hi] == "c", (a.get_text(), lo, hi)
+    lo_f, hi_f = coll.endpoints(ivf)
+    assert a.get_text()[lo_f:hi_f].startswith("c"), (lo_f, hi_f)
+
+
+def _mock_session(n):
+    ids = [f"c{i}" for i in range(n)]
+    s = MockCollabSession(ids)
+    return s, [s.client(i) for i in ids]
+
+
+def test_empty_interval_end_zero_resolves():
+    """end==0 with start/none stickiness stores the DOC_START sentinel
+    as the END ref; endpoints()/signature() must resolve it, not crash
+    (code-review r4)."""
+    from fluidframework_tpu.models.intervals import IntervalCollection
+
+    c = make_client("abc")
+    coll = IntervalCollection("x", c, lambda op: None)
+    iv = coll.add(0, 0, stickiness="none")
+    assert coll.endpoints(iv) == (0, 0)
+    assert coll.signature()  # no AttributeError
+    assert coll.summarize() is not None
