@@ -29,6 +29,13 @@ Two executors share the identical step function:
 kernel is OPT-IN via FFTPU_PALLAS=1 on a TPU backend (correct and
 bit-identical, but Mosaic's current lane-reduce codegen loses to the
 pipelined scan on throughput — see _use_pallas).
+
+STATUS of the Pallas route (round 4): the claim that it is "the route
+to the HBM-optimal single-launch kernel" is RETIRED. The chunked
+executor (ops/merge_chunk.py) now provides launch and HBM
+amortization over K ops per step through plain XLA, without
+depending on Mosaic codegen maturing; the Pallas kernel remains as a
+correctness-proven alternative backend for the single-op step only.
 """
 from __future__ import annotations
 

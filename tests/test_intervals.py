@@ -554,10 +554,15 @@ def test_stickiness_survives_zamboni_compaction():
     assert a.get_text()[lo:hi] == "c"
     lo_f, hi_f = coll.endpoints(ivf)
     assert a.get_text()[lo_f:hi_f] == "c"
-    # advance min_seq well past the removals, forcing zamboni
+    # advance min_seq well past the removals: BOTH clients must keep
+    # submitting, or the silent client floors the msn at 0 and the
+    # zamboni path under test never executes (code-review r4 caught
+    # the first version of this test passing against the broken code)
     for i in range(20):
         a.insert_text_local(a.get_length(), "z")
+        b.insert_text_local(b.get_length(), "y")
         s.process_all()
+    assert a.mergetree.collab.min_seq > 4, "msn never advanced"
     a.zamboni() if hasattr(a, "zamboni") else a.mergetree.zamboni()
     lo, hi = coll.endpoints(iv)
     assert a.get_text()[lo:hi] == "c", (a.get_text(), lo, hi)
