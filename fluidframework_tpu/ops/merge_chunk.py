@@ -63,6 +63,8 @@ Two halves:
 """
 from __future__ import annotations
 
+from bisect import bisect_right
+
 import numpy as np
 
 from .segment_table import (
@@ -221,14 +223,21 @@ def compile_chunks(arrays: dict, k_max: int = 8) -> dict:
         chunk: list[int] = []   # window indices of the open chunk
         base_w = 0              # chunk start window index
         ms_run = 0              # running max min_seq within chunk
+        ms_global = 0           # max min_seq over ALL ops before w
+        ms_base = 0             # ms_global when the chunk opened
+        rm_committed: list[int] = []  # remove seqs of CLOSED chunks
+        rm_open: list[int] = []       # remove seqs in the open chunk
 
         def fresh(w):
-            nonlocal chains, chunk, base_w, ms_run
+            nonlocal chains, chunk, base_w, ms_run, ms_base
             chunk_start[d, w] = 1
             chains = {}
             chunk = []
             base_w = w
             ms_run = 0
+            ms_base = ms_global
+            rm_committed.extend(rm_open)  # stays seq-sorted: stream order
+            rm_open.clear()
 
         fresh(0)
         for w in range(W):
@@ -238,6 +247,7 @@ def compile_chunks(arrays: dict, k_max: int = 8) -> dict:
                     fresh(w)
                 chunk.append(w)
                 ms_run = max(ms_run, int(out["min_seq"][d, w]))
+                ms_global = max(ms_global, int(out["min_seq"][d, w]))
                 continue
             cli = int(out["client"][d, w])
             ref = int(out["refseq"][d, w])
@@ -245,6 +255,20 @@ def compile_chunks(arrays: dict, k_max: int = 8) -> dict:
 
             def must_break():
                 if len(chunk) >= k_max:
+                    return True
+                # Mid-chunk tombstone aging on COMMITTED tombstones:
+                # if min_seq advanced past a pre-chunk remove's seq
+                # since the chunk opened, this insert's `below` mask
+                # (stop-slot eligibility, hence its anchor slot)
+                # differs from earlier in-chunk events' — the device's
+                # same-anchor breakTie rank group would split across
+                # the aged tombstone (seed-90007 class divergence).
+                # ms_global excludes op w's own min_seq: the sequential
+                # step applies an op's min_seq AFTER its view pass, and
+                # the device ms_pre cummax does the same.
+                if kd == KIND_INSERT and ms_global > ms_base and \
+                        bisect_right(rm_committed, ms_global) > \
+                        bisect_right(rm_committed, ms_base):
                     return True
                 for i in chunk:
                     ki = kind[d, i]
@@ -296,8 +320,10 @@ def compile_chunks(arrays: dict, k_max: int = 8) -> dict:
                 ev_cover[d, w] = cover
                 if kd == KIND_REMOVE:
                     chain.apply_remove(p1, p2)
+                    rm_open.append(int(out["seq"][d, w]))
             chunk.append(w)
             ms_run = ms_k
+            ms_global = max(ms_global, int(out["min_seq"][d, w]))
 
     out["chunk_start"] = chunk_start
     out["pred"] = pred
@@ -671,22 +697,23 @@ def _macro_step(st: dict, ops: dict, K: int):
     new_props = []
     for c in range(PROP_CHANNELS):
         cand = ann_eff & (ops["prop_key"][:, None, :] == c)
-        comp = jnp.max(
+        # LWW winner = max window index: within a chunk, lane order IS
+        # sequenced order (compile_chunks emits consecutive window
+        # ops), so no seq*K composite is needed (and none can
+        # overflow int32 — ADVICE r4)
+        win_k = jnp.max(
             jnp.where(
-                cand,
-                ops["seq"][:, None, :] * K
-                + jnp.arange(K, dtype=jnp.int32)[None, None, :],
+                cand, jnp.arange(K, dtype=jnp.int32)[None, None, :],
                 -1,
             ),
             axis=-1,
         )
-        win_k = comp % K
         win_val = jnp.take_along_axis(
             jnp.broadcast_to(ops["prop_val"][:, None, :], (D, R, K)),
             jnp.maximum(win_k, 0)[..., None], axis=-1,
         )[..., 0]
         new_props.append(
-            jnp.where(comp >= 0, win_val, r_props[c])
+            jnp.where(win_k >= 0, win_val, r_props[c])
         )
 
     # ---- overflow ---------------------------------------------------

@@ -274,3 +274,61 @@ def test_min_seq_advance_rides_noops():
     ]
     seq_tab, chunk_tab = _run_raw(rows)
     assert_live_equal(seq_tab, chunk_tab, "noop min_seq")
+
+
+def test_mid_chunk_tombstone_aging_breaks_chunk():
+    """A committed tombstone ages (min_seq crosses its removed seq)
+    between two same-position in-chunk inserts: without a chunk break
+    the two events anchor at different slots and the breakTie rank
+    group splits across the tombstone (seed-90007 divergence class).
+    The compiler must close the chunk at the second insert."""
+    rows = [
+        dict(kind=KIND_INSERT, pos1=0, seq=1, refseq=0, client=0,
+             op_id=0, length=2),                       # "ab"
+        dict(kind=KIND_REMOVE, pos1=1, pos2=2, seq=2, refseq=1,
+             client=1),                                # tombstone 'b'
+        dict(kind=KIND_INSERT, pos1=1, seq=3, refseq=2, client=2,
+             op_id=1, length=1, min_seq=2),            # anchors AT tomb
+        dict(kind=KIND_INSERT, pos1=1, seq=4, refseq=2, client=3,
+             op_id=2, length=1),                       # tomb now below
+    ]
+    batch = _raw(rows)
+    chunked = build_chunked(batch, K=8)
+    # ops 2 and 3 must NOT share a chunk (aging crossed seq 2)
+    assert chunked["chunk_start"][0].tolist()[3] == 1
+    seq_tab, chunk_tab = _run_raw(rows)
+    assert_live_equal(seq_tab, chunk_tab, "mid-chunk aging")
+    seqs = np.asarray(seq_tab.seq)[0, :4].tolist()
+    assert seqs == [1, 4, 3, 1], seqs  # a | op3 | op2 | tomb-b
+
+
+def test_regression_seed_90007():
+    """Driver-caught r4 divergence: 120-step stream whose min_seq
+    advance mid-chunk aged a committed tombstone between two
+    same-position inserts (BENCH_r04 fuzz failure)."""
+    _, stream = record_op_stream(FuzzConfig(
+        n_clients=4, n_steps=120, seed=90007,
+        insert_weight=0.5, remove_weight=0.3,
+        annotate_weight=0.1, process_weight=0.1,
+    ))
+    seq_tab, chunk_tab = run_both([stream], capacity=1024, K=8)
+    assert_live_equal(seq_tab, chunk_tab, "seed 90007")
+
+
+@pytest.mark.parametrize("steps,K,seed0", [
+    (120, 8, 90000), (160, 16, 90020), (200, 4, 90040),
+])
+def test_differential_fuzz_deep(steps, K, seed0):
+    """Bench-mix deep sweep, doc-batched (12 seeds per call) so the
+    suite stays bounded on 1 CPU; the long-stream regime is where the
+    r4 divergence lived (in-repo cap was 90 steps — too shallow)."""
+    streams = []
+    for seed in range(seed0, seed0 + 12):
+        _, s = record_op_stream(FuzzConfig(
+            n_clients=4, n_steps=steps, seed=seed,
+            insert_weight=0.5, remove_weight=0.3,
+            annotate_weight=0.1, process_weight=0.1,
+        ))
+        streams.append(s)
+    seq_tab, chunk_tab = run_both(streams, capacity=2048, K=K)
+    assert_live_equal(seq_tab, chunk_tab, f"deep {steps}/{K}/{seed0}")
