@@ -542,13 +542,13 @@ def test_stickiness_survives_zamboni_compaction():
 
     s, clients = _mock_session(2)
     a, b = clients
-    a.insert_text_local(0, "abcdef")
+    s.do("c0", "insert_text_local", 0, "abcdef")
     s.process_all()
     coll = IntervalCollection("x", a, lambda op: None)
     iv = coll.add(2, 4, stickiness="none")    # 'cd', end AFTER 'd'
     ivf = coll.add(2, 4, stickiness="full")   # start AFTER 'b'
-    a.remove_range_local(3, 4)                # remove 'd'
-    a.remove_range_local(1, 2)                # remove 'b'
+    s.do("c0", "remove_range_local", 3, 4)    # remove 'd'
+    s.do("c0", "remove_range_local", 1, 2)    # remove 'b'
     s.process_all()
     lo, hi = coll.endpoints(iv)
     assert a.get_text()[lo:hi] == "c"
@@ -559,8 +559,8 @@ def test_stickiness_survives_zamboni_compaction():
     # zamboni path under test never executes (code-review r4 caught
     # the first version of this test passing against the broken code)
     for i in range(20):
-        a.insert_text_local(a.get_length(), "z")
-        b.insert_text_local(b.get_length(), "y")
+        s.do("c0", "insert_text_local", a.get_length(), "z")
+        s.do("c1", "insert_text_local", b.get_length(), "y")
         s.process_all()
     assert a.mergetree.collab.min_seq > 4, "msn never advanced"
     a.zamboni() if hasattr(a, "zamboni") else a.mergetree.zamboni()
@@ -574,6 +574,44 @@ def _mock_session(n):
     ids = [f"c{i}" for i in range(n)]
     s = MockCollabSession(ids)
     return s, [s.client(i) for i in ids]
+
+
+@pytest.mark.parametrize("stickiness", ["none", "start", "end", "full"])
+@pytest.mark.parametrize("removal", ["start", "end", "both"])
+def test_zamboni_preserves_endpoints_matrix(stickiness, removal):
+    """Stickiness x anchor-removal x compaction: once an endpoint's
+    anchor char is removed and the interval has settled, running
+    zamboni (which drops the tombstone the ref sits on) must not move
+    either endpoint (VERDICT r4 next #2: full matrix, sequenced)."""
+    from fluidframework_tpu.models.intervals import IntervalCollection
+
+    s, clients = _mock_session(2)
+    a, b = clients
+    s.do("c0", "insert_text_local", 0, "abcdef")
+    s.process_all()
+    coll = IntervalCollection("x", a, lambda op: None)
+    iv = coll.add(2, 4, stickiness=stickiness)  # 'cd'
+    if removal in ("start", "both"):
+        s.do("c0", "remove_range_local", 2, 3)  # start anchor 'c'
+    if removal in ("end", "both"):
+        # end anchor region 'd' (shifted left if 'c' already removed)
+        off = 1 if removal == "both" else 0
+        s.do("c0", "remove_range_local", 3 - off, 4 - off)
+    s.process_all()
+    before = coll.endpoints(iv)
+    # advance msn past the removals (both clients must submit)
+    for _ in range(20):
+        s.do("c0", "insert_text_local", a.get_length(), "z")
+        s.do("c1", "insert_text_local", b.get_length(), "y")
+        s.process_all()
+    assert a.mergetree.collab.min_seq > 4, "msn never advanced"
+    a.mergetree.zamboni()
+    after = coll.endpoints(iv)
+    assert before == after, (
+        f"zamboni moved endpoints: {before} -> {after} "
+        f"(stickiness={stickiness}, removal={removal})"
+    )
+    assert coll.signature()  # resolvable, no crash
 
 
 def test_empty_interval_end_zero_resolves():
