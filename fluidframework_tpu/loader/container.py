@@ -452,6 +452,12 @@ class Container(EventEmitter):
                             self.last_processed_seq
                         ),
                     }
+                except PermissionError:
+                    # an auth misconfiguration (token without write
+                    # scope) is NOT transient: degrading to inline
+                    # summaries forever would mask it — surface it
+                    # (ADVICE r4)
+                    raise
                 except (OSError, RuntimeError, TimeoutError) as e:
                     # a transient storage-upload failure must not
                     # wedge the summarizer (the proposal would never

@@ -133,6 +133,11 @@ class RunningSummarizer(EventEmitter):
         self.attempt_pending = False
         self._attempt_proposal: Optional[int] = None
         self.summaries_produced = 0
+        # sticky auth failure: the upload plane rejected our token for
+        # write scope — retrying every tick cannot succeed, and
+        # re-raising would unwind into the driver's dispatch pump and
+        # kill delta processing for every document on the connection
+        self.auth_failed = False
 
     def on_op(self, msg: SequencedMessage) -> None:
         if msg.type == MessageType.SUMMARIZE:
@@ -173,13 +178,25 @@ class RunningSummarizer(EventEmitter):
         self.maybe_summarize()
 
     def maybe_summarize(self) -> None:
-        if self.attempt_pending or not self.heuristics.should_summarize():
+        if self.auth_failed or self.attempt_pending \
+                or not self.heuristics.should_summarize():
             return
         if self.container.runtime.is_dirty or not self.container.connected:
             return  # wait for quiescence (summarize requires it)
         self.attempt_pending = True
         try:
             self.container.summarize()
+        except PermissionError as e:
+            # surfaced by Container.summarize (ADVICE r4) — on the
+            # AUTO path there is no caller to catch it: record it
+            # loudly, stop attempting (sticky until re-election /
+            # reconnect builds a new summarizer), keep the pump alive
+            self.attempt_pending = False
+            self.auth_failed = True
+            self.container.mc.logger.send_error_event(
+                "summarizeAuthFailed", error=e,
+            )
+            self.emit("authFailed", e)
         except Exception:
             # no proposal was submitted, so no ack/nack will ever
             # clear the flag — reset it or summaries stop forever

@@ -40,6 +40,7 @@ import asyncio
 import dataclasses
 import json
 import struct
+import time
 from typing import Any, Optional
 
 from ..protocol.messages import (
@@ -423,12 +424,16 @@ class AlfredServer:
 
     # upload size guards: a hostile client must not balloon server
     # memory through the staging buffers. Bytes are accounted PER
-    # SESSION across all in-flight uploads (abandoned upload_ids would
-    # otherwise accumulate unbounded), and stale uploads evict
-    # oldest-first past the concurrency cap.
+    # SESSION across all in-flight uploads. Past the concurrency cap,
+    # uploads idle beyond UPLOAD_IDLE_TTL are reclaimed (abandoned
+    # upload_ids must not hold slots/bytes forever), then a NEW
+    # upload_id is rejected loudly — never an in-progress one
+    # (ADVICE r4: evicting a live upload surfaced as a misleading
+    # out-of-order error on its next chunk).
     MAX_UPLOAD_CHUNK = 1 << 20       # 1 MiB per frame
     MAX_UPLOAD_TOTAL = 256 << 20     # 256 MiB staged per session
     MAX_UPLOADS_IN_FLIGHT = 4
+    UPLOAD_IDLE_TTL = 60.0           # seconds without a chunk
 
     def _handle_upload_chunk(self, session: _ClientSession, doc: str,
                              frame: dict) -> None:
@@ -450,15 +455,42 @@ class AlfredServer:
             raise ValueError("upload chunk too large or malformed")
         if total < 1:
             raise ValueError("malformed upload")
+        now = time.monotonic()
+        # reclaim abandoned uploads (e.g. a driver that timed out
+        # mid-upload and never sends the final chunk) on EVERY chunk,
+        # not only at the count cap: an under-cap abandoned upload
+        # would otherwise hold its staged bytes against
+        # MAX_UPLOAD_TOTAL for the session's lifetime
+        for uid in [
+            uid for uid, st in session.uploads.items()
+            if uid != upload_id
+            and now - st["touched"] > self.UPLOAD_IDLE_TTL
+        ]:
+            session.uploads.pop(uid)
         state = session.uploads.get(upload_id)
+        if state is None and chunk_i != 0:
+            # a continuation for an upload we don't know: it was
+            # rejected at the cap, reclaimed by the idle TTL, or never
+            # started — say so, instead of creating fresh state and
+            # failing with a misleading out-of-order error
+            raise ValueError(
+                "unknown upload (rejected, expired, or never started)"
+            )
         if state is None:
-            while len(session.uploads) >= self.MAX_UPLOADS_IN_FLIGHT:
-                # evict the oldest abandoned upload (insertion order)
-                stale = next(iter(session.uploads))
-                session.uploads.pop(stale)
+            if len(session.uploads) >= self.MAX_UPLOADS_IN_FLIGHT:
+                # Reject loudly: evicting a fresh upload would kill a
+                # legitimately in-progress one on a multiplexed
+                # connection, and its next chunk would then fail with
+                # a misleading out-of-order error (ADVICE r4).
+                raise ValueError(
+                    "too many concurrent uploads "
+                    f"(max {self.MAX_UPLOADS_IN_FLIGHT})"
+                )
             state = session.uploads[upload_id] = {
                 "doc": doc, "parts": [], "total": total,
+                "touched": now,
             }
+        state["touched"] = now
         if state["doc"] != doc or state["total"] != total \
                 or chunk_i != len(state["parts"]):
             session.uploads.pop(upload_id, None)

@@ -169,3 +169,31 @@ def test_summary_manager_dispose_detaches():
         a.flush()
     # no summaries: the disposed manager stopped observing
     assert server.get_orderer("doc").summary_store.latest() is None
+
+
+def test_auto_summarize_permission_error_sticky_not_fatal():
+    """A PermissionError from the upload plane on the AUTO path (event
+    pump) must not unwind into the driver's dispatch loop (it would
+    kill delta processing for every doc on the connection): the
+    summarizer records it, goes sticky-disabled, and the pump lives
+    (code-review r5)."""
+    server, factory, (a, b), (ma, mb) = make(2)
+
+    def denied(summary):
+        raise PermissionError("token lacks doc:write")
+
+    a.service.upload_summary = denied
+    events = []
+    ma.running.on("authFailed", lambda e: events.append(e))
+    t = a.runtime.create_datastore("ds").create_channel(
+        "sharedstring", "t")
+    a.flush()
+    for i in range(8):  # past the op threshold (5)
+        t.insert_text(0, "x")
+        a.flush()  # would raise out of the pump without the fix
+    assert ma.running.auth_failed
+    assert len(events) == 1 and isinstance(events[0], PermissionError)
+    # sticky: no further attempts, and no exception on later ops
+    t.insert_text(0, "y")
+    a.flush()
+    assert ma.running.summaries_produced == 0

@@ -205,10 +205,15 @@ def test_upload_out_of_order_chunk_rejected(alfred):
     svc = SocketDocumentService("127.0.0.1", server.port, "d",
                                 timeout=15.0)
     try:
+        svc._request({
+            "type": "upload_summary_chunk", "document_id": "d",
+            "upload_id": "u1", "chunk": 0, "total": 3,
+            "data": "xx",
+        })
         with pytest.raises(RuntimeError, match="out of order"):
             svc._request({
                 "type": "upload_summary_chunk", "document_id": "d",
-                "upload_id": "u1", "chunk": 1, "total": 3,
+                "upload_id": "u1", "chunk": 2, "total": 3,
                 "data": "xx",
             })
     finally:
@@ -309,3 +314,98 @@ def test_sigkill_restart_resumes_from_client_uploaded_summary(
     finally:
         server.kill()
         server.wait()
+
+
+def test_upload_concurrency_limit_rejects_new_not_evicts_old(alfred):
+    """Hitting MAX_UPLOADS_IN_FLIGHT must reject the NEW upload with
+    an explicit error; in-flight uploads keep working (ADVICE r4: the
+    old eviction killed a live upload on a multiplexed connection and
+    its next chunk then failed with a misleading out-of-order error)."""
+    import json as _json
+
+    server = alfred()
+    svc = SocketDocumentService("127.0.0.1", server.port, "d",
+                                timeout=15.0)
+    try:
+        payload = _json.dumps({"runtime": {}})
+        for i in range(4):  # MAX_UPLOADS_IN_FLIGHT
+            svc._request({
+                "type": "upload_summary_chunk", "document_id": "d",
+                "upload_id": f"u{i}", "chunk": 0, "total": 2,
+                "data": payload[:1],
+            })
+        with pytest.raises(RuntimeError,
+                           match="too many concurrent uploads"):
+            svc._request({
+                "type": "upload_summary_chunk", "document_id": "d",
+                "upload_id": "u-over", "chunk": 0, "total": 2,
+                "data": payload[:1],
+            })
+        # the in-flight upload u0 is untouched: its final chunk lands
+        resp = svc._request({
+            "type": "upload_summary_chunk", "document_id": "d",
+            "upload_id": "u0", "chunk": 1, "total": 2,
+            "data": payload[1:],
+        })
+        assert resp.get("handle")
+        # abandoned uploads are reclaimed once idle past the TTL:
+        # u1-u3 are still staged; after the TTL, FOUR brand-new
+        # uploads must all be accepted — impossible unless the three
+        # abandoned ones were swept (non-vacuous: without the sweep
+        # the second new id below hits the cap)
+        server.UPLOAD_IDLE_TTL = 0.05
+        time.sleep(0.2)
+        for i in range(4):
+            resp = svc._request({
+                "type": "upload_summary_chunk", "document_id": "d",
+                "upload_id": f"u-new{i}", "chunk": 0, "total": 2,
+                "data": payload[:1],
+            })
+            assert resp.get("type") != "error", resp
+    finally:
+        svc.close()
+
+
+def test_container_summarize_surfaces_permission_error():
+    """An upload plane that rejects for auth must raise out of
+    summarize(), not silently degrade to inline summaries forever
+    (ADVICE r4: PermissionError is an OSError subclass and was
+    swallowed by the transient-failure fallback)."""
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.service import LocalServer
+
+    server = LocalServer()
+    svc = LocalDocumentServiceFactory(server).create_document_service(
+        "doc")
+
+    def denied(summary):
+        raise PermissionError("token lacks doc:write")
+
+    svc.upload_summary = denied
+    c = Container.load(svc, client_id="alice")
+    c.runtime.create_datastore("ds").create_channel("sharedstring", "t")
+    c.flush()
+    with pytest.raises(PermissionError):
+        c.summarize()
+    c.close()
+
+
+def test_upload_continuation_of_unknown_id_distinct_error(alfred):
+    """chunk>0 for an id the server doesn't know (rejected at the cap,
+    TTL-reclaimed, or never started) gets an accurate error, not the
+    misleading 'out of order' from a freshly-created state
+    (code-review r5)."""
+    server = alfred()
+    svc = SocketDocumentService("127.0.0.1", server.port, "d",
+                                timeout=15.0)
+    try:
+        with pytest.raises(RuntimeError,
+                           match="rejected, expired, or never started"):
+            svc._request({
+                "type": "upload_summary_chunk", "document_id": "d",
+                "upload_id": "ghost", "chunk": 1, "total": 3,
+                "data": "xx",
+            })
+    finally:
+        svc.close()
