@@ -65,4 +65,11 @@ def __getattr__(name):
         from . import broker
 
         return getattr(broker, name)
+    # same lazy treatment: `python -m fluidframework_tpu.service.moira`
+    # runs the Materialized History CLI
+    if name in ("MaterializedHistoryServer",
+                "MaterializedHistoryClient", "MoiraLambda"):
+        from . import moira
+
+        return getattr(moira, name)
     raise AttributeError(name)
