@@ -451,6 +451,10 @@ def stage_fuzz(scale: str, reps: int, cooldown: float) -> dict:
             if not np.array_equal(seq_tab[f][d, :n],
                                   chunk_tab[f][d, :n]):
                 mismatches.append(("executor-divergence", d, f))
+    if os.environ.get("FFTPU_FUZZ_SABOTAGE"):
+        # test hook: prove a correctness failure poisons the run's
+        # top-level status (VERDICT r4 weak #7 / next #8)
+        mismatches.append(("sabotage", -1))
     assert not mismatches, f"fuzz mismatches: {mismatches}"
     return {
         "seeds": n_seeds,
@@ -867,7 +871,16 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
     next round; only the final round syncs. A per-round-synced pass
     afterwards records the round-latency percentiles. Scalar-Python
     pipeline baseline (per-op sequencer + scalar merge observers) on a
-    subset, as before."""
+    subset, as before.
+
+    SERVING ROUTE IS BACKEND-AWARE (VERDICT r4 next #4): on a TPU
+    backend the merge apply is the XLA kernel (the batched device
+    lane); on a host without an accelerator the product route is the
+    native host tier — the same C++ engines the sidecar's eviction
+    path serves from (MergeHostSession, merge_replay.cpp Session) —
+    NOT an XLA CPU emulation of the device kernel. The r4 CPU number
+    (0.52x scalar python) measured the latter; the host tier is the
+    honest CPU pipeline."""
     import numpy as np
 
     from fluidframework_tpu.models.mergetree import MergeTreeClient
@@ -983,12 +996,60 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
         ))
     rounds = len(round_data)
 
+    import jax as _jax
+
+    use_host_tier = _jax.default_backend() != "tpu"
+    if use_host_tier:
+        from fluidframework_tpu.native.replay_baseline import (
+            MergeHostSession,
+        )
+
+        F_SEQ = OP_FIELDS.index("seq")
+        F_MSN = OP_FIELDS.index("min_seq")
+        for rd in round_data:
+            # flat row-major [n_rows, 12] in per-doc sequenced order —
+            # the host tier's natural layout (no padding lanes)
+            win = rd["win"]
+            doc_of_row = (rd["flat_dst"] // win).astype(np.int32)
+            row_in_doc = (rd["flat_dst"] % win).astype(np.int64)
+            flat = np.zeros(
+                (len(doc_of_row), len(OP_FIELDS)), np.int32
+            )
+            for j, f in enumerate(OP_FIELDS):
+                flat[:, j] = rd["content"][f][doc_of_row, row_in_doc]
+            rd["flat_rows"] = np.ascontiguousarray(flat)
+            rd["doc_of_row"] = doc_of_row
+
     def make_seqs():
         m = MultiDocSequencer(docs)
         for d in range(docs):
             for c in range(clients):
                 m.join(d, c)
         return m
+
+    def run_pipeline_host(sync_each_round: bool):
+        """CPU serving route: native sequencer -> native merge tier.
+        No device in the loop; `sync_each_round` only toggles the
+        latency sampling (the tier is synchronous by nature)."""
+        seqs = make_seqs()
+        sess = MergeHostSession(docs)
+        lat = []
+        total = 0
+        t0 = time.perf_counter()
+        for rd in round_data:
+            tr = time.perf_counter()
+            seq, msn, status = seqs.ticket_boxcar(
+                rd["doc_start"], rd["cids"], rd["csns"], rd["refs"]
+            )
+            assert not status.any(), "config5 unexpected nack"
+            rows = np.array(rd["flat_rows"])  # copy: reused across reps
+            rows[:, F_SEQ] = np.repeat(seq, rd["counts"])
+            rows[:, F_MSN] = np.repeat(msn, rd["counts"])
+            sess.apply(rows, rd["doc_of_row"])
+            total += rows.shape[0]
+            if sync_each_round:
+                lat.append(time.perf_counter() - tr)
+        return sess, total, time.perf_counter() - t0, lat
 
     def run_pipeline(sync_each_round: bool):
         seqs = make_seqs()
@@ -1019,14 +1080,17 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
         _sync(table)
         return table, total, time.perf_counter() - t0, lat
 
-    run_pipeline(False)  # warmup: compiles the window shapes
+    run = run_pipeline_host if use_host_tier else run_pipeline
+    if not use_host_tier:
+        run(False)  # warmup: compiles the window shapes
     times = []
     for _ in range(max(reps, 2)):
         time.sleep(cooldown)
-        table, total_real, elapsed, _ = run_pipeline(False)
+        state, total_real, elapsed, _ = run(False)
         times.append(elapsed)
     best = min(times)
-    _, _, _, lat = run_pipeline(True)  # latency pass (per-round sync)
+    state, _, _, lat = run(True)  # latency pass (per-round sync)
+    table = None if use_host_tier else state
 
     # scalar-python pipeline baseline (per-op objects), sample docs
     from fluidframework_tpu.protocol.messages import ClientDetail
@@ -1058,17 +1122,23 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
             scalar_ops += 1
     py_ops_s = scalar_ops / max(time.perf_counter() - t1, 1e-9)
 
-    # parity: device table text vs scalar oracle replay
-    np_table = fetch(table)
-    assert not np_table["overflow"].any(), "config5 overflow"
+    # parity: pipeline tip text vs scalar oracle replay (both routes)
     n_check = min(4, docs)
+    if use_host_tier:
+        np_table = None
+    else:
+        np_table = fetch(table)
+        assert not np_table["overflow"].any(), "config5 overflow"
     for d in range(n_check):
         obs = MergeTreeClient("obs")
         obs.start_collaboration("obs")
         for msg in raw[d % base]:
             if msg.type == MessageType.OPERATION:
                 obs.apply_msg(msg)
-        got = extract_text(np_table, prep[d % base]["enc"], d)
+        if use_host_tier:
+            got = state.text(d, prep[d % base]["enc"])
+        else:
+            got = extract_text(np_table, prep[d % base]["enc"], d)
         assert got == obs.get_text(), (
             f"config5 pipeline/oracle divergence doc {d}"
         )
@@ -1078,6 +1148,9 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
         "docs": docs,
         "sessions": docs * clients,
         "rounds": rounds,
+        "serving_route": (
+            "host-native-tier" if use_host_tier else "device-xla"
+        ),
         "pipeline_ops_per_sec": round(total_real / best, 1),
         "kernel_ops_per_sec": round(total_real / best, 1),
         "py_baseline_ops_per_sec": round(py_ops_s, 1),
@@ -1376,21 +1449,66 @@ def main() -> None:
                          args.tpu_timeout, args.cpu_timeout,
                          args.total_budget)
 
+    # correctness poisoning (VERDICT r4 weak #7 / next #8): a failed
+    # correctness stage must flip the RUN's status — top-level flag
+    # next to the headline AND a nonzero exit — never sit buried in
+    # `failures` under rc 0 while the headline reads green.
+    correctness_failures: list[str] = []
+    fuzz_res = detail["stages"].get("fuzz")
+    if "fuzz" in stages and (
+        fuzz_res is None
+        or fuzz_res.get("result") != "all-signatures-match"
+    ):
+        # missing entirely also poisons: a run with no fuzz evidence
+        # cannot claim its kernel numbers are of a correct kernel
+        correctness_failures.append("fuzz")
+    for stage, attempts in detail["failures"].items():
+        # an AssertionError on ANY backend attempt is a kernel/parity
+        # divergence on that backend — a later attempt succeeding on a
+        # DIFFERENT backend does not vouch for it (a smaller CPU fuzz
+        # pass cannot clear a TPU divergence)
+        if stage in correctness_failures:
+            continue
+        if any("AssertionError" in a for a in attempts):
+            correctness_failures.append(stage)
+    for stage, res in detail["stages"].items():
+        # salvage() keeps the main record when the fixed-scale
+        # companion dies; a companion ASSERT is still a recorded
+        # divergence and must poison the run like any other
+        comp = res.get("companion_failure", "")
+        if "AssertionError" in comp and stage not in \
+                correctness_failures:
+            correctness_failures.append(stage)
+
+    def emit(payload: dict) -> None:
+        payload["correctness_failed"] = bool(correctness_failures)
+        if correctness_failures:
+            payload["correctness_failures"] = correctness_failures
+        print(json.dumps(payload))
+        if correctness_failures:
+            sys.exit(1)
+
     primary = detail["stages"].get("config2") or next(
         (v for k, v in detail["stages"].items()
          if "kernel_ops_per_sec" in v), None
     )
     if primary is None:
-        print(json.dumps({
+        if not detail["stages"] and not correctness_failures:
+            # nothing at all ran — no evidence, poison the run (a
+            # probe/fuzz-only invocation with green results is fine)
+            correctness_failures.append("all-stages-failed")
+        note = ("all stages failed" if not detail["stages"]
+                else "no perf stage in this invocation")
+        emit({
             "metric": "mergetree_batched_ops_per_sec",
             "value": 0,
             "unit": "ops/s",
             "vs_baseline": 0,
             "detail": {
-                "error": "all stages failed",
+                "error": note,
                 **detail,
             },
-        }))
+        })
         return
 
     value = primary["kernel_ops_per_sec"]
@@ -1407,7 +1525,7 @@ def main() -> None:
     else:
         vs = value / py if py else 0
         baseline_kind = "in-repo scalar Python replay (C++ unavailable)"
-    print(json.dumps({
+    emit({
         "metric": "mergetree_batched_ops_per_sec",
         "value": round(value, 1),
         "unit": "ops/s",
@@ -1416,7 +1534,7 @@ def main() -> None:
             "baseline": baseline_kind,
             **detail,
         },
-    }))
+    })
 
 
 if __name__ == "__main__":

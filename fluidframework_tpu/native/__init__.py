@@ -133,10 +133,28 @@ def load_merge_replay() -> Optional[ctypes.CDLL]:
             _replay_error = f"CDLL load failed: {e}"
             return None
         i64 = ctypes.c_int64
+        i32p = ctypes.POINTER(ctypes.c_int32)
         lib.merge_replay.restype = None
         lib.merge_replay.argtypes = [
-            ctypes.POINTER(ctypes.c_int32), i64, i64,
+            i32p, i64, i64,
             ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(i64),
+        ]
+        lib.merge_session_create.restype = ctypes.c_void_p
+        lib.merge_session_create.argtypes = [i64]
+        lib.merge_session_destroy.restype = None
+        lib.merge_session_destroy.argtypes = [ctypes.c_void_p]
+        lib.merge_session_apply.restype = None
+        lib.merge_session_apply.argtypes = [
+            ctypes.c_void_p, i32p, i32p, i64,
+        ]
+        lib.merge_session_stats.restype = None
+        lib.merge_session_stats.argtypes = [
+            ctypes.c_void_p, i64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(i64),
+        ]
+        lib.merge_session_segs.restype = i64
+        lib.merge_session_segs.argtypes = [
+            ctypes.c_void_p, i64, i32p, i64,
         ]
         _replay_lib = lib
         return _replay_lib

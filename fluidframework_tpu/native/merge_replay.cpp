@@ -203,9 +203,71 @@ struct Doc {
   }
 };
 
+// Incremental multi-document session — the HOST SERVING TIER the
+// full-service pipeline routes merges through on hosts without an
+// accelerator (config5's CPU path; the sidecar's host tier uses the
+// same engines). Unlike merge_replay (from-scratch, one doc), a
+// session holds per-doc state across rounds and applies flat
+// round batches of (row, doc) pairs in sequenced order.
+struct Session {
+  std::vector<Doc> docs;
+};
+
 }  // namespace
 
 extern "C" {
+
+void* merge_session_create(int64_t n_docs) {
+  auto* s = new Session();
+  s->docs.resize(static_cast<size_t>(n_docs));
+  for (auto& d : s->docs) d.segs.reserve(64);
+  return s;
+}
+
+void merge_session_destroy(void* h) {
+  delete static_cast<Session*>(h);
+}
+
+// rows: [n_rows][12] int32 (OP_FIELDS order), doc_of_row: [n_rows].
+// Rows must arrive in sequenced order per document (the round batch).
+void merge_session_apply(void* h, const int32_t* rows,
+                         const int32_t* doc_of_row, int64_t n_rows) {
+  auto* s = static_cast<Session*>(h);
+  for (int64_t i = 0; i < n_rows; ++i)
+    s->docs[static_cast<size_t>(doc_of_row[i])]
+        .apply(rows + i * kFields);
+}
+
+void merge_session_stats(void* h, int64_t doc,
+                         uint64_t* out_checksum, int64_t* out_live) {
+  auto* s = static_cast<Session*>(h);
+  const Doc& d = s->docs[static_cast<size_t>(doc)];
+  if (out_checksum) *out_checksum = d.checksum();
+  int64_t live = 0;
+  for (const Seg& seg : d.segs)
+    if (seg.removed_seq == kNotRemoved) live += seg.length;
+  if (out_live) *out_live = live;
+}
+
+// Live non-marker segments as (op_id, op_off, length) triples for
+// host-side text reconstruction (host_bridge.extract_text shape).
+// Returns the number of triples; writes at most `cap`.
+int64_t merge_session_segs(void* h, int64_t doc, int32_t* out,
+                           int64_t cap) {
+  auto* s = static_cast<Session*>(h);
+  const Doc& d = s->docs[static_cast<size_t>(doc)];
+  int64_t n = 0;
+  for (const Seg& seg : d.segs) {
+    if (seg.removed_seq != kNotRemoved || seg.is_marker) continue;
+    if (n < cap) {
+      out[n * 3 + 0] = seg.op_id;
+      out[n * 3 + 1] = seg.op_off;
+      out[n * 3 + 2] = seg.length;
+    }
+    ++n;
+  }
+  return n;
+}
 
 // Replay one document's op stream `reps` times from scratch; returns
 // nanoseconds-free op count actually applied (reps * n_ops) and the

@@ -75,3 +75,84 @@ def table_checksum(table_np: dict[str, np.ndarray], doc: int) -> int:
             for p in props:
                 h = mix(p, h)
     return h
+
+
+class MergeHostSession:
+    """Incremental multi-document merge state in C++ — the host
+    serving tier the full-service pipeline routes through on hosts
+    without an accelerator (the device path is the XLA/TPU kernel;
+    the sidecar evicts cold docs to these same engines).
+
+    Rows must be fed in sequenced order per document; each round is
+    one ``apply(rows, doc_of_row)`` call with row-major
+    ``[n_rows, 12]`` int32 (OP_FIELDS order).
+    """
+
+    def __init__(self, n_docs: int):
+        lib = load_merge_replay()
+        if lib is None:
+            raise RuntimeError("native merge tier unavailable")
+        self._lib = lib
+        self._h = lib.merge_session_create(n_docs)
+        self.n_docs = n_docs
+
+    def apply(self, rows: np.ndarray, doc_of_row: np.ndarray) -> None:
+        assert rows.ndim == 2 and rows.shape[1] == len(OP_FIELDS)
+        rows = np.ascontiguousarray(rows, np.int32)
+        doc_of_row = np.ascontiguousarray(doc_of_row, np.int32)
+        assert rows.shape[0] == doc_of_row.shape[0]
+        if doc_of_row.size:
+            # bounds-check HERE: C++ indexes s->docs[doc] unchecked,
+            # so a bad index would be heap corruption, not an error
+            lo, hi = int(doc_of_row.min()), int(doc_of_row.max())
+            assert 0 <= lo and hi < self.n_docs, (
+                f"doc_of_row out of range [{lo},{hi}] "
+                f"for {self.n_docs} docs"
+            )
+        self._lib.merge_session_apply(
+            self._h,
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            doc_of_row.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            rows.shape[0],
+        )
+
+    def stats(self, doc: int) -> tuple[int, int]:
+        """(checksum, live_chars) of one doc's tip view."""
+        checksum = ctypes.c_uint64(0)
+        live = ctypes.c_int64(0)
+        self._lib.merge_session_stats(
+            self._h, doc, ctypes.byref(checksum), ctypes.byref(live)
+        )
+        return checksum.value, live.value
+
+    def text(self, doc: int, stream: DocStream) -> str:
+        """Tip-view text via (op_id, op_off, length) triples — same
+        reconstruction as host_bridge.extract_text."""
+        cap = 256
+        while True:
+            out = np.zeros((cap, 3), np.int32)
+            n = self._lib.merge_session_segs(
+                self._h, doc,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                cap,
+            )
+            if n <= cap:
+                break
+            cap = int(n)
+        parts = []
+        for op_id, off, length in out[:n]:
+            parts.append(
+                stream.payloads[int(op_id)][int(off):int(off) + int(length)]
+            )
+        return "".join(parts)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.merge_session_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC ordering
+        try:
+            self.close()
+        except Exception:
+            pass

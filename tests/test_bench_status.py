@@ -1,0 +1,40 @@
+"""bench.py run-status contract (VERDICT r4 next #8): a correctness-
+stage failure must poison the run — top-level flag + nonzero exit —
+never hide in `failures` under rc 0."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--smoke",
+         "--stages", "probe,fuzz"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    line = proc.stdout.strip().splitlines()[-1]
+    return proc.returncode, json.loads(line)
+
+
+@pytest.mark.slow
+def test_green_fuzz_reports_clean_status():
+    rc, out = _run()
+    assert rc == 0
+    assert out["correctness_failed"] is False
+    assert out["detail"]["stages"]["fuzz"]["result"] == \
+        "all-signatures-match"
+
+
+@pytest.mark.slow
+def test_seeded_fuzz_failure_flips_run_status():
+    rc, out = _run({"FFTPU_FUZZ_SABOTAGE": "1"})
+    assert rc != 0
+    assert out["correctness_failed"] is True
+    assert "fuzz" in out["correctness_failures"]
