@@ -10,7 +10,12 @@ from .compat import (
     downgrade_channel_summary,
     import_as_fresh_document,
 )
-from .fuzz import FuzzConfig, record_op_stream, run_convergence_fuzz
+from .fuzz import (
+    FuzzConfig,
+    record_flow_stream,
+    record_op_stream,
+    run_convergence_fuzz,
+)
 from .mocks import MockCollabSession
 
 __all__ = [
@@ -20,6 +25,7 @@ __all__ = [
     "compat_matrix",
     "downgrade_channel_summary",
     "import_as_fresh_document",
+    "record_flow_stream",
     "record_op_stream",
     "run_convergence_fuzz",
 ]
