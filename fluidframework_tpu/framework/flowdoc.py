@@ -35,11 +35,14 @@ semantics alone.
 """
 from __future__ import annotations
 
-import itertools
+import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
-MARKER_PARAGRAPH = 100   # shared with richtext.py
+# paragraph markers are a WIRE contract both bindings read off the
+# same SharedString — one definition (richtext owns it)
+from .richtext import MARKER_PARAGRAPH  # noqa: F401 (re-exported)
+
 MARKER_LINEBREAK = 101
 MARKER_TAG_BEGIN = 102
 MARKER_TAG_END = 103
@@ -52,8 +55,6 @@ PROP_CLASS = "class"
 PROP_HEADING = "heading"
 
 TAGS = ("em", "strong", "code", "span", "h1", "h2")
-
-_pair_counter = itertools.count(1)
 
 
 # ----------------------------------------------------------------------
@@ -163,8 +164,11 @@ class FlowDocument:
         insertTags): two markers sharing a pairId; the end marker goes
         in first so the begin insert doesn't shift its position."""
         assert tag in TAGS, tag
-        pair = next(_pair_counter)
-        pair_id = f"{self.user}-{pair}"
+        # uuid, not a process-local counter: two processes editing the
+        # same doc as the same user must never mint colliding pairIds
+        # (partner matching is by pairId alone — intervals.py uses the
+        # same scheme for interval ids)
+        pair_id = uuid.uuid4().hex
         self.string.insert_marker(
             end, MARKER_TAG_END, {PROP_PAIR: pair_id})
         self.string.insert_marker(
