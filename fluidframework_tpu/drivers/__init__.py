@@ -9,6 +9,7 @@ from .caching_driver import (
     MultiplexedSocketClient,
     SnapshotCache,
 )
+from .debug_driver import DebugDocumentService
 from .definitions import DeltaStreamConnection, DocumentService
 from .driver_utils import (
     PrefetchingDocumentService,
@@ -23,9 +24,24 @@ from .socket_driver import (
     SocketDocumentService,
     SocketDocumentServiceFactory,
 )
+from .url_resolver import (
+    LocalUrlResolver,
+    ResolvedUrl,
+    SocketUrlResolver,
+    UrlResolver,
+    load_container_from_url,
+    resolve_request,
+)
 
 __all__ = [
     "CachingDocumentService",
+    "DebugDocumentService",
+    "LocalUrlResolver",
+    "ResolvedUrl",
+    "SocketUrlResolver",
+    "UrlResolver",
+    "load_container_from_url",
+    "resolve_request",
     "CachingMultiplexFactory",
     "DeltaStreamConnection",
     "DocumentService",

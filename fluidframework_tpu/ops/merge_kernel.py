@@ -102,6 +102,13 @@ def apply_window_impl(table: SegmentTable, batch: OpBatch) -> SegmentTable:
 _apply_window_xla = jax.jit(apply_window_impl)
 
 
+def compiled_window():
+    """PUBLIC handle to the exact jit object ``apply_window``
+    dispatches (for AOT cost analysis / instrumentation — bench's
+    HBM accounting); keeps callers off the private alias."""
+    return _apply_window_xla
+
+
 def _use_pallas(table: SegmentTable) -> bool:
     # Opt-in (FFTPU_PALLAS=1): the Mosaic kernel is correctness-proven
     # on-chip but the XLA scan currently wins on throughput

@@ -1,0 +1,187 @@
+"""URL resolvers + debugger driver (the §2.6 aux-drivers row).
+
+Mirrors packages/drivers/routerlicious-urlResolver (urlResolver.ts:25),
+local-driver/localResolver.ts:32, and debugger/
+fluidDebuggerController.ts:34.
+"""
+import asyncio
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.drivers import (
+    DebugDocumentService,
+    LocalDocumentServiceFactory,
+    LocalUrlResolver,
+    SocketUrlResolver,
+    load_container_from_url,
+    resolve_request,
+)
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.service.ingress import AlfredServer
+from fluidframework_tpu.service.local_server import LocalServer
+
+
+@pytest.fixture()
+def alfred():
+    state = {}
+
+    def start(tenants=None):
+        server = AlfredServer(tenants=tenants)
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(server.start())
+            started.set()
+            loop.run_forever()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert started.wait(10)
+        state.update(server=server, loop=loop, thread=t)
+        return server
+
+    yield start
+    if state:
+        fut = asyncio.run_coroutine_threadsafe(
+            state["server"].stop(), state["loop"])
+        try:
+            fut.result(timeout=10)
+        except Exception:
+            pass
+        state["loop"].call_soon_threadsafe(state["loop"].stop)
+        state["thread"].join(timeout=10)
+
+
+def test_socket_resolver_parses_fftpu_urls():
+    r = SocketUrlResolver()
+    res = r.resolve("fftpu://10.0.0.5:7071/acme/doc%201")
+    assert res.tenant_id == "acme"
+    assert res.document_id == "doc 1"
+    assert res.endpoints["ordering"] == {
+        "host": "10.0.0.5", "port": 7071}
+    assert res.url == "fftpu://10.0.0.5:7071/acme/doc%201"
+    assert r.get_absolute_url(res, "/dds/map1") == \
+        "fftpu://10.0.0.5:7071/acme/doc%201/dds/map1"
+
+
+def test_socket_resolver_tenantless_and_http_localhost():
+    r = SocketUrlResolver()
+    res = r.resolve("fftpu://127.0.0.1:7070/solo-doc")
+    assert res.tenant_id is None and res.document_id == "solo-doc"
+    res2 = r.resolve("http://localhost:7070/t/d")
+    assert (res2.tenant_id, res2.document_id) == ("t", "d")
+    # foreign hosts are not ours (resolver chains)
+    assert r.resolve("http://example.com/t/d") is None
+    assert r.resolve("odsp://whatever") is None
+
+
+def test_resolver_chain_and_token_provider():
+    minted = []
+
+    def mint(tenant, doc):
+        minted.append((tenant, doc))
+        return f"jwt-{tenant}-{doc}"
+
+    local = LocalUrlResolver(LocalServer())
+    sock = SocketUrlResolver(token_provider=mint)
+    res = resolve_request([local, sock],
+                          "fftpu://127.0.0.1:7070/acme/d")
+    assert res.tokens["jwt"] == "jwt-acme-d"
+    assert minted == [("acme", "d")]
+    res2 = resolve_request([local, sock], "fftpu-local:///dev-doc")
+    assert "local_server" in res2.endpoints
+    with pytest.raises(ValueError, match="no resolver"):
+        resolve_request([local, sock], "odsp://foo/bar")
+
+
+def test_load_container_via_local_resolver():
+    server = LocalServer()
+    resolvers = [LocalUrlResolver(server)]
+    c, svc = load_container_from_url(
+        resolvers, "fftpu-local:///resolved-doc", client_id="alice")
+    t = c.runtime.create_datastore("ds").create_channel(
+        "sharedstring", "t")
+    t.insert_text(0, "via resolver")
+    c.flush()
+    c2, _ = load_container_from_url(
+        resolvers, "fftpu-local:///resolved-doc", client_id="bob")
+    t2 = c2.runtime.get_datastore("ds").get_channel("t")
+    assert t2.get_text() == "via resolver"
+    c.close()
+    c2.close()
+
+
+def test_load_container_via_socket_resolver(alfred):
+    server = alfred()
+    url = f"fftpu://127.0.0.1:{server.port}/wire-doc"
+    c, svc = load_container_from_url(
+        [SocketUrlResolver()], url, client_id="alice")
+    try:
+        with svc.lock:
+            t = c.runtime.create_datastore("ds").create_channel(
+                "sharedstring", "t")
+            t.insert_text(0, "over tcp")
+            c.flush()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with svc.lock:
+                if c.runtime.pending.count == 0:
+                    break
+            time.sleep(0.02)
+        with svc.lock:
+            assert t.get_text() == "over tcp"
+            c.close()
+    finally:
+        svc.close()
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_debug_driver_steps_through_live_stream():
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    # writer fills the doc live
+    w = Container.load(factory.create_document_service("dbg"),
+                       client_id="writer")
+    tw = w.runtime.create_datastore("ds").create_channel(
+        "sharedstring", "t")
+    w.flush()
+    # debugger-wrapped reader joins paused
+    dbg = DebugDocumentService(
+        factory.create_document_service("dbg"), start_paused=True)
+    r = Container.load(dbg, client_id="reader")
+    tr = r.runtime.get_datastore("ds").get_channel("t")
+    for ch in "abcde":
+        tw.insert_text(tw.get_length(), ch)
+        w.flush()
+    assert dbg.pending_count >= 5  # gated, nothing delivered
+    assert tr.get_text() == ""
+    n = dbg.step(3)  # releases 3 MESSAGES (joins/attach ops count)
+    assert n == 3
+    mid = tr.get_text()
+    assert mid != tw.get_text()  # still behind the writer
+    assert tw.get_text().startswith(mid)  # replayed a true prefix
+    # play_to a specific sequence number
+    dbg.play_to(dbg.delivered_seq + 1)
+    assert tw.get_text().startswith(tr.get_text())
+    # breakpoint far ahead doesn't block resume
+    dbg.break_at = 10 ** 9
+    dbg.resume_live()
+    assert _wait(lambda: tr.get_text() == tw.get_text())
+    # live now: new writer ops flow straight through
+    tw.insert_text(0, "z")
+    w.flush()
+    assert _wait(lambda: tr.get_text() == tw.get_text())
+    w.close()
+    r.close()
