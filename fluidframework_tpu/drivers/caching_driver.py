@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from ..protocol.constants import wire_version_lt
 from ..protocol.serialization import decode_contents, encode_contents  # noqa: F401 - decode used by cache load
 from .socket_driver import (
     SocketDeltaConnection,
@@ -189,6 +190,7 @@ class _DocumentFacade:
         self.token = token
         self.mode = mode
         self.auth_error: Optional[str] = None
+        self.agreed_version: Optional[str] = None
         self._connected = threading.Event()
         self._on_message: Optional[Callable] = None
         self._on_nack: Optional[Callable] = None
@@ -235,6 +237,15 @@ class _DocumentFacade:
             self.document_id, auth=(self.tenant_id, self.token))
 
     def upload_summary(self, summary: dict) -> str:
+        # same wire >= 1.1 guard as the single-socket driver: on a
+        # 1.0-agreed connection degrade to inline summaries instead
+        # of sending frames the server will reject
+        if self.agreed_version is not None and \
+                wire_version_lt(self.agreed_version, "1.1"):
+            raise RuntimeError(
+                f"summary upload needs wire >= 1.1; connection "
+                f"agreed {self.agreed_version}"
+            )
         return self._client._doc_upload_summary(
             self.document_id, summary,
             auth=(self.tenant_id, self.token))
@@ -286,6 +297,7 @@ class MultiplexedSocketClient(SocketDocumentService):
     def _on_connected(self, frame: dict) -> None:
         facade = self._facades.get(frame.get("document_id", ""))
         if facade is not None:
+            facade.agreed_version = frame.get("version")
             facade._connected.set()
 
     def _on_connect_error(self, frame: dict) -> None:

@@ -37,6 +37,7 @@ import threading
 from typing import Callable, Optional
 
 from ..protocol.messages import DocumentMessage, Nack, NackErrorType, SequencedMessage
+from ..protocol.constants import wire_version_lt
 from ..protocol.serialization import decode_contents, message_from_json
 from ..service.ingress import document_message_to_json, pack_frame
 
@@ -44,21 +45,24 @@ _LEN = struct.Struct(">I")
 
 
 # wire versions this driver speaks, newest first (the server echoes
-# the agreed one in "connected"; see ingress.WIRE_VERSIONS)
-WIRE_VERSIONS = ("1.0",)
+# the agreed one in "connected"; see ingress.WIRE_VERSIONS for what
+# each version adds — 1.1 is the chunked summary-upload plane)
+WIRE_VERSIONS = ("1.1", "1.0")
 
 
 def build_connect_frame(document_id: str, client_id: str, mode: str,
-                        tenant_id=None, token=None) -> dict:
+                        tenant_id=None, token=None,
+                        versions=None) -> dict:
     """The connect_document handshake frame — ONE definition so the
     single-socket and multiplexed drivers cannot diverge on auth/mode
-    fields."""
+    fields. ``versions`` overrides the offer (compat tests pin an
+    old client against a new server)."""
     frame = {
         "type": "connect_document",
         "document_id": document_id,
         "client_id": client_id,
         "mode": mode,
-        "versions": list(WIRE_VERSIONS),
+        "versions": list(versions or WIRE_VERSIONS),
     }
     if token is not None:
         frame["tenant_id"] = tenant_id
@@ -73,13 +77,18 @@ class SocketDocumentService:
                  timeout: float = 30.0,
                  tenant_id: Optional[str] = None,
                  token: Optional[str] = None,
-                 mode: str = "write"):
+                 mode: str = "write",
+                 wire_versions=None):
         self.document_id = document_id
         # riddler-analogue auth (service/tenancy.py): sent with the
         # connect_document handshake when the server gates on tokens
         self.tenant_id = tenant_id
         self.token = token
         self.mode = mode
+        # offered wire versions (override pins an old client for the
+        # compat matrix); the server's pick lands in agreed_version
+        self.wire_versions = tuple(wire_versions or WIRE_VERSIONS)
+        self.agreed_version: Optional[str] = None
         self.auth_error: Optional[str] = None
         self.lock = threading.RLock()
         self._timeout = timeout
@@ -187,6 +196,7 @@ class SocketDocumentService:
     def _on_connected(self, frame: dict) -> None:
         """Handshake-ack hook (the multiplexing subclass routes by
         document_id)."""
+        self.agreed_version = frame.get("version")
         self._connected.set()
 
     def _on_connect_error(self, frame: dict) -> None:
@@ -264,7 +274,8 @@ class SocketDocumentService:
             raise ConnectionError("connection closed")
         self._send(build_connect_frame(
             self.document_id, client_id, self.mode,
-            self.tenant_id, self.token))
+            self.tenant_id, self.token,
+            versions=self.wire_versions))
         if not self._connected.wait(self._timeout):
             raise TimeoutError("connect_document handshake timed out")
         if self.auth_error is not None:
@@ -306,7 +317,18 @@ class SocketDocumentService:
         root handle — the storage half of the reference's summarize
         flow (driver-definitions/src/storage.ts:119
         uploadSummaryWithContext): the summarize op then proposes the
-        handle instead of carrying the tree on the op stream."""
+        handle instead of carrying the tree on the op stream.
+
+        Wire >= 1.1 only: on a 1.0-agreed connection raise the
+        transient-shaped error the container's summarize fallback
+        catches, so an old-server pairing degrades to inline
+        summaries instead of sending frames the server rejects."""
+        if self.agreed_version is not None and \
+                wire_version_lt(self.agreed_version, "1.1"):
+            raise RuntimeError(
+                f"summary upload needs wire >= 1.1; connection "
+                f"agreed {self.agreed_version}"
+            )
         return self._doc_upload_summary(
             self.document_id, summary,
             auth=(self.tenant_id, self.token))

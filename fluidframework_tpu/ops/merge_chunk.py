@@ -815,17 +815,12 @@ def apply_window_chunked(table: SegmentTable, chunked: dict,
     """Apply a compiled chunk program (``compile_chunks`` output, as
     jnp/np [D, W] arrays) to the table. ``K`` must equal the compile
     k_max."""
-    key = K
-    if key not in _jit_cache:
-        _jit_cache[key] = jax.jit(
-            lambda st, ops: _window_loop(st, ops, K)
-        )
     st = _chunk_state(table)
     ops_w = {
         f: jnp.asarray(chunked[f])
         for f in OpBatch._fields + CHUNK_FIELDS
     }
-    st = _jit_cache[key](st, ops_w)
+    st = _get_jit(K)(st, ops_w)
     return _chunk_unstate(dict(st))
 
 
@@ -837,19 +832,27 @@ def build_chunked(batch: OpBatch, K: int = 8) -> dict:
     )
 
 
+def _get_jit(K: int):
+    """One cache-fill site: ``apply_window_chunked`` and
+    ``compiled_window`` must hand out the SAME jit object per K or
+    the AOT cost-analysis path stops resolving from the compilation
+    cache."""
+    if K not in _jit_cache:
+        _jit_cache[K] = jax.jit(
+            lambda st, ops: _window_loop(st, ops, K)
+        )
+    return _jit_cache[K]
+
+
 def compiled_window(table: SegmentTable, chunked: dict, K: int = 8):
     """PUBLIC handle for AOT cost analysis / instrumentation of the
     chunked executor: returns (jitted, args) for the SAME jit object
     ``apply_window_chunked`` dispatches at this K, with the traced
     argument structure — bench's HBM accounting resolves it from the
     compilation cache instead of reaching into _jit_cache."""
-    if K not in _jit_cache:
-        _jit_cache[K] = jax.jit(
-            lambda st, ops: _window_loop(st, ops, K)
-        )
     args = (
         _chunk_state(table),
         {f: jnp.asarray(chunked[f])
          for f in OpBatch._fields + CHUNK_FIELDS},
     )
-    return _jit_cache[K], args
+    return _get_jit(K), args

@@ -20,3 +20,12 @@ NON_COLLAB_CLIENT = -2
 # pending *segment* as the second highest (the op being placed always
 # sequences after segments already in the tree).
 MAX_SEQ = 2**53 - 1
+
+
+def wire_version_lt(a: str, b: str) -> bool:
+    """Wire-protocol version ordering — ONE definition shared by the
+    server's frame gate (service/ingress) and the driver's client-side
+    guard (drivers/socket_driver): numeric dotted compare, so '1.10'
+    orders above '1.2'."""
+    return tuple(int(x) for x in a.split(".")) < \
+        tuple(int(x) for x in b.split("."))

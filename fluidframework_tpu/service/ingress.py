@@ -43,6 +43,7 @@ import struct
 import time
 from typing import Any, Optional
 
+from ..protocol.constants import wire_version_lt
 from ..protocol.messages import (
     ClientDetail,
     DocumentMessage,
@@ -69,7 +70,14 @@ MAX_FRAME = 64 * 1024 * 1024
 # picks the newest shared one and echoes it in "connected"; no overlap
 # is a connect error, not a silent mismatch. Snapshot formats are
 # versioned separately (testing/compat.py); this covers the FRAMES.
-WIRE_VERSIONS = ("1.0",)
+#
+# 1.0 — base frames: connect/op/nack/read_ops/summary/summarize.
+# 1.1 — adds the chunked summary-upload plane (upload_summary_chunk)
+#       and structured error kinds. A connection that NEGOTIATED 1.0
+#       must not use 1.1 frames (server rejects them; the driver
+#       degrades to inline summaries — the old-client/new-service
+#       pairing of the compat matrix, tests/test_wire_compat.py).
+WIRE_VERSIONS = ("1.1", "1.0")
 
 
 def document_message_to_json(op: DocumentMessage) -> dict:
@@ -169,6 +177,9 @@ class _ClientSession:
         self.write_authorized: set[str] = set()
         # in-flight chunked summary uploads: upload_id -> state
         self.uploads: dict[str, dict] = {}
+        # doc -> wire version agreed at connect_document (absent =
+        # never negotiated on this session)
+        self.wire_versions: dict[str, str] = {}
 
     def send(self, data: dict) -> None:
         self.outbound.put_nowait(pack_frame(data))
@@ -371,6 +382,7 @@ class AlfredServer:
             session.authorized.add(doc)
             if mode == "write":
                 session.write_authorized.add(doc)
+            session.wire_versions[doc] = agreed
             session.send({
                 "type": "connected", "document_id": doc,
                 "client_id": client_id, "version": agreed,
@@ -413,6 +425,15 @@ class AlfredServer:
                 payload["summary"] = encode_contents(latest.summary)
             session.send(payload)
         elif kind == "upload_summary_chunk":
+            # a connection that NEGOTIATED wire 1.0 must not use 1.1
+            # frames; raw upload frames without a prior negotiation
+            # self-evidently speak 1.1 and pass
+            agreed = session.wire_versions.get(doc)
+            if agreed is not None and wire_version_lt(agreed, "1.1"):
+                raise ValueError(
+                    f"summary upload requires wire version >= 1.1 "
+                    f"(connection agreed {agreed})"
+                )
             self._check_write_access(session, doc, frame)
             self._handle_upload_chunk(session, doc, frame)
         elif kind == "disconnect_document":

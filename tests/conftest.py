@@ -24,3 +24,54 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavyweight multi-process tests"
     )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def alfred(monkeypatch):
+    """AlfredServer on a background event loop — ONE definition for
+    every wire-level test file. ``start(tenants=..., 
+    server_versions=...)`` returns the running server; teardown stops
+    it and joins the thread."""
+    import asyncio
+    import threading
+
+    state = {}
+
+    def start(tenants=None, server_versions=None):
+        from fluidframework_tpu.service import ingress as ingress_mod
+        from fluidframework_tpu.service.ingress import AlfredServer
+
+        if server_versions is not None:
+            monkeypatch.setattr(
+                ingress_mod, "WIRE_VERSIONS", tuple(server_versions))
+        server = AlfredServer(tenants=tenants)
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(server.start())
+            started.set()
+            loop.run_forever()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert started.wait(10)
+        state.update(server=server, loop=loop, thread=t)
+        return server
+
+    yield start
+    if state:
+        import asyncio
+
+        fut = asyncio.run_coroutine_threadsafe(
+            state["server"].stop(), state["loop"])
+        try:
+            fut.result(timeout=10)
+        except Exception:
+            pass
+        state["loop"].call_soon_threadsafe(state["loop"].stop)
+        state["thread"].join(timeout=10)

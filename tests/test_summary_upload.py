@@ -32,39 +32,6 @@ from fluidframework_tpu.service.tenancy import (
 )
 
 
-@pytest.fixture()
-def alfred():
-    """AlfredServer on a background loop; yields (server, tenants
-    setter is not needed — pass tenants via factory)."""
-    state = {}
-
-    def start(tenants=None):
-        server = AlfredServer(tenants=tenants)
-        loop = asyncio.new_event_loop()
-        started = threading.Event()
-
-        def run():
-            asyncio.set_event_loop(loop)
-            loop.run_until_complete(server.start())
-            started.set()
-            loop.run_forever()
-
-        t = threading.Thread(target=run, daemon=True)
-        t.start()
-        assert started.wait(10)
-        state.update(server=server, loop=loop, thread=t)
-        return server
-
-    yield start
-    if state:
-        fut = asyncio.run_coroutine_threadsafe(
-            state["server"].stop(), state["loop"])
-        try:
-            fut.result(timeout=10)
-        except Exception:
-            pass
-        state["loop"].call_soon_threadsafe(state["loop"].stop)
-        state["thread"].join(timeout=10)
 
 
 def _wait(pred, timeout=10.0):

@@ -23,37 +23,6 @@ from fluidframework_tpu.service.ingress import AlfredServer
 from fluidframework_tpu.service.local_server import LocalServer
 
 
-@pytest.fixture()
-def alfred():
-    state = {}
-
-    def start(tenants=None):
-        server = AlfredServer(tenants=tenants)
-        loop = asyncio.new_event_loop()
-        started = threading.Event()
-
-        def run():
-            asyncio.set_event_loop(loop)
-            loop.run_until_complete(server.start())
-            started.set()
-            loop.run_forever()
-
-        t = threading.Thread(target=run, daemon=True)
-        t.start()
-        assert started.wait(10)
-        state.update(server=server, loop=loop, thread=t)
-        return server
-
-    yield start
-    if state:
-        fut = asyncio.run_coroutine_threadsafe(
-            state["server"].stop(), state["loop"])
-        try:
-            fut.result(timeout=10)
-        except Exception:
-            pass
-        state["loop"].call_soon_threadsafe(state["loop"].stop)
-        state["thread"].join(timeout=10)
 
 
 def test_socket_resolver_parses_fftpu_urls():

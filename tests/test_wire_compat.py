@@ -1,0 +1,181 @@
+"""Live wire-version compat matrix (describeCompat analogue for the
+FRAME axis — packages/test/test-version-utils pairs old clients with
+new services and vice versa; here the pairings are real TCP sessions
+against a real server, not format shims).
+
+Wire 1.0 = base frames; wire 1.1 adds the chunked summary-upload
+plane. The matrix drives: negotiation outcome, live collaboration
+across mixed-version clients, and the summarizer's degrade-to-inline
+path whenever either side lacks 1.1.
+"""
+import asyncio
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.drivers.socket_driver import (
+    SocketDocumentService,
+)
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.service import ingress as ingress_mod
+from fluidframework_tpu.service.ingress import AlfredServer
+
+
+
+
+def _pump(svc, container, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        with svc.lock:
+            if container.runtime.pending.count == 0:
+                return True
+        time.sleep(0.02)
+    return False
+
+
+def _load(port, doc, client_id, versions=None):
+    svc = SocketDocumentService("127.0.0.1", port, doc,
+                                timeout=15.0,
+                                wire_versions=versions)
+    with svc.lock:
+        c = Container.load(svc, client_id=client_id)
+    return svc, c
+
+
+@pytest.mark.parametrize("client_versions,server_versions,agreed", [
+    (("1.1", "1.0"), ("1.1", "1.0"), "1.1"),  # new / new
+    (("1.0",), ("1.1", "1.0"), "1.0"),        # old client / new srv
+    (("1.1", "1.0"), ("1.0",), "1.0"),        # new client / old srv
+])
+def test_negotiation_matrix(alfred, client_versions,
+                            server_versions, agreed):
+    server = alfred(server_versions=server_versions)
+    svc, c = _load(server.port, "neg", "alice",
+                   versions=client_versions)
+    try:
+        assert svc.agreed_version == agreed
+        with svc.lock:
+            t = c.runtime.create_datastore("ds").create_channel(
+                "sharedstring", "t")
+            t.insert_text(0, "negotiated")
+            c.flush()
+        assert _pump(svc, c)
+        with svc.lock:
+            assert t.get_text() == "negotiated"
+            c.close()
+    finally:
+        svc.close()
+
+
+def test_no_common_version_is_connect_error(alfred):
+    server = alfred()
+    svc = SocketDocumentService("127.0.0.1", server.port, "nc",
+                                timeout=15.0,
+                                wire_versions=("0.9",))
+    try:
+        with pytest.raises(Exception, match="no common wire version"):
+            with svc.lock:
+                Container.load(svc, client_id="alice")
+    finally:
+        svc.close()
+
+
+@pytest.mark.parametrize("pairing,client_versions,server_versions", [
+    ("old-client-new-server", ("1.0",), ("1.1", "1.0")),
+    ("new-client-old-server", ("1.1", "1.0"), ("1.0",)),
+])
+def test_summarize_degrades_to_inline_on_10_pairings(
+        alfred, pairing, client_versions, server_versions):
+    """Either 1.0 pairing: the upload plane is unavailable, the
+    summarizer must degrade to an INLINE summary that still lands and
+    is loadable — never a wedge, never a server-side frame error."""
+    server = alfred(server_versions=server_versions)
+    svc, c = _load(server.port, "deg", "alice",
+                   versions=client_versions)
+    try:
+        assert svc.agreed_version == "1.0"
+        with pytest.raises(RuntimeError, match="wire"):
+            svc.upload_summary({"runtime": {}})
+        with svc.lock:
+            t = c.runtime.create_datastore("ds").create_channel(
+                "sharedstring", "t")
+            t.insert_text(0, "inline fallback")
+            c.flush()
+        assert _pump(svc, c)
+        with svc.lock:
+            c.summarize()
+        deadline = time.time() + 10
+        latest = None
+        while time.time() < deadline and latest is None:
+            with svc.lock:
+                latest = svc.get_latest_summary()
+            time.sleep(0.05)
+        assert latest is not None, f"{pairing}: summary never landed"
+        _, summary = latest
+        assert "runtime" in summary  # inline tree, not a handle stub
+        # a fresh (new) client loads from it
+        svc2, c2 = _load(server.port, "deg", "bob")
+        with svc2.lock:
+            t2 = c2.runtime.get_datastore("ds").get_channel("t")
+            assert t2.get_text() == "inline fallback"
+            c2.close()
+        svc2.close()
+        with svc.lock:
+            c.close()
+    finally:
+        svc.close()
+
+
+def test_mixed_version_clients_collaborate(alfred):
+    """An old (1.0) and a new (1.1) client on the SAME document
+    converge over live ops — frame compat is per-connection, not
+    per-document."""
+    server = alfred()
+    svc_old, c_old = _load(server.port, "mix", "old",
+                           versions=("1.0",))
+    svc_new, c_new = _load(server.port, "mix", "new")
+    try:
+        assert svc_old.agreed_version == "1.0"
+        assert svc_new.agreed_version == "1.1"
+        with svc_old.lock:
+            t_old = c_old.runtime.create_datastore(
+                "ds").create_channel("sharedstring", "t")
+            t_old.insert_text(0, "from old ")
+            c_old.flush()
+        assert _pump(svc_old, c_old)
+        time.sleep(0.3)
+        with svc_new.lock:
+            t_new = c_new.runtime.get_datastore(
+                "ds").get_channel("t")
+            t_new.insert_text(t_new.get_length(), "from new")
+            c_new.flush()
+        assert _pump(svc_new, c_new)
+        time.sleep(0.3)
+        with svc_old.lock, svc_new.lock:
+            assert t_old.get_text() == t_new.get_text() == \
+                "from old from new"
+            c_old.close()
+            c_new.close()
+    finally:
+        svc_old.close()
+        svc_new.close()
+
+
+def test_negotiated_10_connection_cannot_use_upload_frames(alfred):
+    """Server-side enforcement: a connection that AGREED 1.0 gets a
+    loud error for 1.1 frames (not a silent accept)."""
+    server = alfred()
+    svc, c = _load(server.port, "enf", "alice", versions=("1.0",))
+    try:
+        with pytest.raises(RuntimeError,
+                           match="requires wire version >= 1.1"):
+            svc._request({
+                "type": "upload_summary_chunk", "document_id": "enf",
+                "upload_id": "u", "chunk": 0, "total": 1,
+                "data": "{}",
+            })
+        with svc.lock:
+            c.close()
+    finally:
+        svc.close()
