@@ -107,8 +107,14 @@ class Ink(SharedObject, EventEmitter):
     def _apply(self, op: dict) -> None:
         kind = op["type"]
         if kind == "createStroke":
+            # carry the id IN the stroke record (IInkStroke.id): a
+            # view painting get_strokes() needs a replica-independent
+            # z-order, and local dict insertion order differs across
+            # replicas for concurrent strokes
             self._strokes.setdefault(
-                op["id"], {"pen": dict(op["pen"]), "points": []}
+                op["id"],
+                {"id": op["id"], "pen": dict(op["pen"]),
+                 "points": []},
             )
         elif kind == "stylus":
             stroke = self._strokes.get(op["id"])
@@ -128,7 +134,7 @@ class Ink(SharedObject, EventEmitter):
 
     def load_core(self, summary: dict) -> None:
         self._strokes = {
-            k: {"pen": dict(v["pen"]),
+            k: {"id": k, "pen": dict(v["pen"]),
                 "points": [dict(p) for p in v["points"]]}
             for k, v in summary["strokes"].items()
         }
