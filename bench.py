@@ -982,6 +982,24 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
         "cpu": (1024, 8, 120, 3, 512, 48),
         "smoke": (64, 4, 40, 2, 256, 20),
     }[scale]
+
+    from fluidframework_tpu.native import (
+        load_native_sequencer,
+        native_build_error,
+    )
+
+    if load_native_sequencer() is None:
+        # EVERY route tickets through the native boxcar sequencer; a
+        # host with no C++ toolchain gets an explicit marker record
+        # instead of a crash deep in MultiDocSequencer.__init__
+        return {
+            "docs": docs,
+            "skipped": (
+                "native sequencer unavailable: "
+                f"{native_build_error() or 'toolchain missing'}"
+            ),
+        }
+
     raw, encoded = _build_streams(base, steps, clients, seed0=777)
 
     # ---- corpus prep (columnar; one-time, untimed) ------------------
@@ -1077,7 +1095,21 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
 
     import jax as _jax
 
-    use_host_tier = _jax.default_backend() != "tpu"
+    from fluidframework_tpu.native import (
+        load_merge_replay,
+        merge_replay_error,
+    )
+
+    on_tpu = _jax.default_backend() == "tpu"
+    # CPU product route = the native host tier. Without a working C++
+    # toolchain load_merge_replay() is None — fall back to the XLA
+    # pipeline on CPU and LABEL the record "emulation" instead of
+    # dying inside MergeHostSession.__init__ (a missing g++ used to
+    # kill the whole stage)
+    use_host_tier = not on_tpu and load_merge_replay() is not None
+    host_tier_error = None if (on_tpu or use_host_tier) else (
+        merge_replay_error() or "host tier unavailable"
+    )
     if use_host_tier:
         from fluidframework_tpu.native.replay_baseline import (
             MergeHostSession,
@@ -1228,8 +1260,14 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
         "sessions": docs * clients,
         "rounds": rounds,
         "serving_route": (
-            "host-native-tier" if use_host_tier else "device-xla"
+            "device-xla" if on_tpu
+            else "host-native-tier" if use_host_tier
+            # XLA-on-CPU stand-in for the device kernel — NOT the
+            # honest CPU product route (see r4: 0.52x scalar python)
+            else "emulation"
         ),
+        **({"host_tier_error": host_tier_error}
+           if host_tier_error else {}),
         "pipeline_ops_per_sec": round(total_real / best, 1),
         "kernel_ops_per_sec": round(total_real / best, 1),
         "py_baseline_ops_per_sec": round(py_ops_s, 1),

@@ -1,0 +1,105 @@
+"""fluidlint CLI.
+
+    python -m fluidframework_tpu.analysis [paths...] [options]
+
+Exit status 0 when every finding is suppressed or allowlisted, 1
+otherwise (2 for usage errors). ``--json`` emits a machine-readable
+report for BENCH/ADVICE tooling.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import (
+    ALLOWLIST_PATH,
+    DEFAULT_ROOTS,
+    FAMILIES,
+    REPO_ROOT,
+    apply_allowlist,
+    load_allowlist,
+    run_analysis,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fluidframework_tpu.analysis",
+        description="fluidlint: layercheck + jaxhazards + lockcheck",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/directories to scan (default: the repo tree)",
+    )
+    parser.add_argument(
+        "--rules", default=",".join(FAMILIES),
+        help="comma-separated pass families to run "
+             f"(default: {','.join(FAMILIES)})",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as JSON "
+             "{findings, allowlisted, stale_allowlist}",
+    )
+    parser.add_argument(
+        "--allowlist", default=ALLOWLIST_PATH,
+        help="allowlist file (default: analysis/allowlist.txt)",
+    )
+    parser.add_argument(
+        "--no-allowlist", action="store_true",
+        help="report grandfathered findings too",
+    )
+    args = parser.parse_args(argv)
+
+    families = [f for f in args.rules.split(",") if f]
+    try:
+        findings = run_analysis(
+            roots=args.paths or DEFAULT_ROOTS,
+            families=families,
+            repo_root=REPO_ROOT,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    allowlist = [] if args.no_allowlist else load_allowlist(
+        args.allowlist
+    )
+    kept, stale = apply_allowlist(findings, allowlist)
+    n_allowed = len(findings) - len(kept)
+    if args.paths:
+        # a partial-path scan legitimately misses allowlisted
+        # findings elsewhere in the tree; staleness is only
+        # meaningful (and only enforced, here and in the gate test)
+        # on a full default-roots run
+        stale = []
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in kept],
+            "allowlisted": n_allowed,
+            "stale_allowlist": [
+                {"rule": r, "key": k} for r, k in stale
+            ],
+            "families": families,
+        }, indent=2))
+    else:
+        for f in kept:
+            print(f.format())
+        for rule, key in stale:
+            print(
+                f"allowlist entry '{rule} {key}' matches no finding "
+                "anymore — delete it (the ratchet only goes down)"
+            )
+        summary = (
+            f"fluidlint: {len(kept)} finding(s), "
+            f"{n_allowed} allowlisted, {len(stale)} stale allowlist "
+            f"entr{'y' if len(stale) == 1 else 'ies'}"
+        )
+        print(summary, file=sys.stderr)
+    return 1 if (kept or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

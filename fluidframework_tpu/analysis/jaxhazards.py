@@ -1,0 +1,364 @@
+"""jaxhazards — nondeterminism and recompile hazards in jitted code.
+
+A jitted function traces ONCE per input shape: a wall-clock or RNG
+call inside it bakes one arbitrary value into the compiled program
+(silent nondeterminism between runs that share a compile cache but not
+between reruns — the worst kind for a differential-oracle repo), a
+Python ``if`` on a tracer raises at best and silently specializes at
+worst, an unhashable static arg fails at call time, and a host
+callback stalls the device pipeline per step. All four are cheap to
+pin down mechanically.
+
+Detection is module-local and conservative: jit roots are functions
+decorated ``@jax.jit`` / ``@partial(jax.jit, ...)`` or wrapped via
+``jax.jit(fn, ...)`` call forms; reachability follows bare-name calls
+to functions defined in the same module (cross-module reachability
+would need whole-program type inference — out of scope, and kernels
+here are module-contained). ``jax.debug.print`` is NOT flagged: it is
+the sanctioned in-jit debug mechanism.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Finding, SourceFile
+
+# dotted-path prefixes whose call inside jit-reachable code is
+# nondeterministic at trace time
+NONDET_PREFIXES = (
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "time.process_time",
+    "time.time_ns",
+    "random.",
+    "numpy.random.",
+    "os.urandom",
+    "uuid.uuid1",   # uuid3/uuid5 are deterministic in their inputs
+    "uuid.uuid4",
+    "secrets.",
+)
+
+# host-callback / side-effect surfaces inside traced code
+HOST_CALLBACKS = (
+    "print",
+    "input",
+    "jax.debug.callback",
+    "jax.pure_callback",
+    "jax.experimental.io_callback",
+    "jax.experimental.host_callback.",
+)
+
+
+def _import_aliases(tree: ast.AST) -> dict[str, str]:
+    """local name -> dotted path, from every import in the module
+    (function-local ones included: a jitted body may import locally)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 \
+                and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _dotted(node: ast.AST, aliases: dict[str, str]) -> Optional[str]:
+    """Resolve a Name/Attribute chain to a dotted path with import
+    aliases substituted; None for anything non-static (calls,
+    subscripts)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+def _matches(dotted: str, prefixes: tuple[str, ...]) -> bool:
+    return any(
+        dotted == p or (p.endswith(".") and dotted.startswith(p))
+        or (not p.endswith(".") and dotted.startswith(p + "."))
+        for p in prefixes
+    )
+
+
+class _JitRoot:
+    def __init__(self, fn: ast.FunctionDef,
+                 static_argnums: tuple[int, ...],
+                 static_argnames: tuple[str, ...],
+                 analyze_params: bool = True):
+        self.fn = fn
+        self.static_argnums = static_argnums
+        self.static_argnames = static_argnames
+        # False for functions reached through a jitted LAMBDA
+        # (jax.jit(lambda st: _loop(st, k))): their params bind
+        # closure values that are static at trace time, so the
+        # tracer-branch/static-arg rules would misfire — only the
+        # reachability rules (nondeterminism, host callbacks) apply
+        self.analyze_params = analyze_params
+
+
+def _literal(node: Optional[ast.AST]):
+    if node is None:
+        return None
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _statics_from_call(call: ast.Call) -> tuple[tuple[int, ...],
+                                                tuple[str, ...]]:
+    nums = _literal(next(
+        (k.value for k in call.keywords if k.arg == "static_argnums"),
+        None,
+    ))
+    names = _literal(next(
+        (k.value for k in call.keywords if k.arg == "static_argnames"),
+        None,
+    ))
+    if isinstance(nums, int):
+        nums = (nums,)
+    if isinstance(names, str):
+        names = (names,)
+    return tuple(nums or ()), tuple(names or ())
+
+
+def _find_roots(tree: ast.AST, aliases: dict[str, str]
+                ) -> list[_JitRoot]:
+    by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+
+    roots: list[_JitRoot] = []
+
+    def is_jit(node: ast.AST) -> bool:
+        return _dotted(node, aliases) == "jax.jit"
+
+    for fns in by_name.values():
+        for fn in fns:
+            for dec in fn.decorator_list:
+                if is_jit(dec):
+                    roots.append(_JitRoot(fn, (), ()))
+                elif isinstance(dec, ast.Call):
+                    target = _dotted(dec.func, aliases)
+                    if target == "jax.jit":
+                        roots.append(
+                            _JitRoot(fn, *_statics_from_call(dec))
+                        )
+                    elif target in ("functools.partial", "partial") \
+                            and dec.args and is_jit(dec.args[0]):
+                        roots.append(
+                            _JitRoot(fn, *_statics_from_call(dec))
+                        )
+    # call-wrapping forms: x = jax.jit(fn, ...) and
+    # x = jax.jit(lambda ...: helper(...), ...)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and is_jit(node.func)
+                and node.args):
+            continue
+        wrapped = node.args[0]
+        if isinstance(wrapped, ast.Name):
+            for fn in by_name.get(wrapped.id, []):
+                roots.append(_JitRoot(fn, *_statics_from_call(node)))
+        elif isinstance(wrapped, ast.Lambda):
+            for sub in ast.walk(wrapped):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name):
+                    for fn in by_name.get(sub.func.id, []):
+                        roots.append(
+                            _JitRoot(fn, (), (), analyze_params=False)
+                        )
+    return roots
+
+
+def _reachable(roots: list[_JitRoot], tree: ast.AST
+               ) -> list[ast.FunctionDef]:
+    """Functions reachable from jit roots via bare-name calls to
+    module-local definitions (the roots themselves included)."""
+    by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    seen: dict[int, ast.FunctionDef] = {}
+    queue = [r.fn for r in roots]
+    while queue:
+        fn = queue.pop()
+        if id(fn) in seen:
+            continue
+        seen[id(fn)] = fn
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name):
+                for callee in by_name.get(node.func.id, []):
+                    if id(callee) not in seen:
+                        queue.append(callee)
+    return list(seen.values())
+
+
+def _is_value_branch(test: ast.expr) -> bool:
+    """True for tests whose truthiness needs the VALUE of the operand
+    (tracer hazard). Identity checks against None, isinstance, and
+    shape/dtype attribute probes resolve at trace time and are fine."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_value_branch(test.operand)
+    if isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    ):
+        return False
+    if isinstance(test, ast.Call):
+        callee = test.func
+        if isinstance(callee, ast.Name) and callee.id in (
+            "isinstance", "callable", "hasattr", "len",
+        ):
+            return False
+    return True
+
+
+def _names_in(node: ast.AST) -> list[ast.Name]:
+    """Name refs whose VALUE the test consumes. A name only reached
+    through an attribute access (``table.capacity``, ``x.shape``) is a
+    metadata/aux-field probe — static under tracing — and excluded."""
+    attr_bases = {
+        id(n.value) for n in ast.walk(node)
+        if isinstance(n, ast.Attribute)
+    }
+    return [
+        n for n in ast.walk(node)
+        if isinstance(n, ast.Name) and id(n) not in attr_bases
+    ]
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings = []
+    for src in files:
+        if src.tree is None:
+            continue
+        aliases = _import_aliases(src.tree)
+        roots = _find_roots(src.tree, aliases)
+        if not roots:
+            continue
+        module = src.relpath.rsplit("/", 1)[-1]
+
+        # -- nondeterminism + host callbacks in jit-reachable code ----
+        for fn in _reachable(roots, src.tree):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func, aliases)
+                if dotted is None:
+                    continue
+                if _matches(dotted, NONDET_PREFIXES):
+                    findings.append(Finding(
+                        rule="jit-nondeterminism",
+                        path=src.relpath, line=node.lineno,
+                        message=(
+                            f"{dotted}() inside jit-reachable "
+                            f"{fn.name}(): the value is baked in at "
+                            "trace time (one arbitrary sample per "
+                            "compile) — pass it in as an argument"
+                        ),
+                        key=f"{module}:{fn.name}:{dotted}",
+                    ))
+                elif _matches(dotted, HOST_CALLBACKS):
+                    findings.append(Finding(
+                        rule="jit-host-callback",
+                        path=src.relpath, line=node.lineno,
+                        message=(
+                            f"{dotted}() inside jit-reachable "
+                            f"{fn.name}(): host callbacks stall the "
+                            "device pipeline per step (use "
+                            "jax.debug.print for debugging, or move "
+                            "the effect outside the kernel)"
+                        ),
+                        key=f"{module}:{fn.name}:{dotted}",
+                    ))
+
+        # -- per-root: tracer branches + unhashable statics ------------
+        for root in roots:
+            if not root.analyze_params:
+                continue
+            fn = root.fn
+            args = fn.args
+            pos = list(args.posonlyargs) + list(args.args)
+            nonstatic = {
+                a.arg for i, a in enumerate(pos)
+                if i not in root.static_argnums
+                and a.arg not in root.static_argnames
+                and a.arg not in ("self", "cls")
+            }
+            # keyword-only params trace too; only static_argnames can
+            # mark them static (static_argnums is positional)
+            nonstatic |= {
+                a.arg for a in args.kwonlyargs
+                if a.arg not in root.static_argnames
+            }
+            for node in ast.walk(fn):
+                tests = []
+                if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    tests.append(node.test)
+                elif isinstance(node, ast.Assert):
+                    tests.append(node.test)
+                for test in tests:
+                    if not _is_value_branch(test):
+                        continue
+                    hit = next(
+                        (n for n in _names_in(test)
+                         if n.id in nonstatic), None,
+                    )
+                    if hit is not None:
+                        findings.append(Finding(
+                            rule="jit-tracer-branch",
+                            path=src.relpath, line=test.lineno,
+                            message=(
+                                f"Python branch on parameter "
+                                f"{hit.id!r} of jitted {fn.name}(): "
+                                "under tracing this raises (or "
+                                "silently specializes); use lax.cond/"
+                                "jnp.where, or mark the arg static"
+                            ),
+                            key=f"{module}:{fn.name}:{hit.id}",
+                        ))
+            defaults = args.defaults
+            # defaults align with the TAIL of positional params;
+            # kw_defaults align 1:1 with kwonlyargs (None = absent)
+            offset = len(pos) - len(defaults)
+            static_with_default = []
+            for i, a in enumerate(pos):
+                if i not in root.static_argnums and \
+                        a.arg not in root.static_argnames:
+                    continue
+                static_with_default.append(
+                    (a, defaults[i - offset] if i >= offset else None)
+                )
+            static_with_default.extend(
+                (a, d) for a, d in zip(args.kwonlyargs,
+                                       args.kw_defaults)
+                if a.arg in root.static_argnames
+            )
+            for a, default in static_with_default:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set,
+                                        ast.ListComp, ast.DictComp,
+                                        ast.SetComp)):
+                    findings.append(Finding(
+                        rule="jit-static-unhashable",
+                        path=src.relpath, line=default.lineno,
+                        message=(
+                            f"static arg {a.arg!r} of jitted "
+                            f"{fn.name}() defaults to an unhashable "
+                            "mutable — static args key the compile "
+                            "cache and must be hashable (use a tuple/"
+                            "frozenset or a frozen dataclass)"
+                        ),
+                        key=f"{module}:{fn.name}:{a.arg}",
+                    ))
+    return findings

@@ -1,0 +1,205 @@
+"""layercheck — machine-enforced package layering.
+
+The reference monorepo's layer-check build step pins which release
+group may depend on which (Loader < Runtime < Framework < ...); this
+is the same gate for the reproduction's subpackages. The declared
+order, bottom to top:
+
+    utils < protocol < {models, runtime, ops} < native < drivers
+          < loader < {framework, parallel} < service-facing tools
+
+with two sanctioned mutual pairs mirroring the reference's release
+groups (local-driver <-> local-server): drivers <-> service and
+native <-> service. ``ALLOWED`` below is the single source of truth —
+tests/test_layer_check.py asserts against this exact map, so the
+tier-1 suite and the linter cannot drift apart.
+
+Only MODULE-LEVEL imports create edges: TYPE_CHECKING blocks and
+function-local imports cannot create import cycles and are the
+sanctioned escape hatch for the remaining upward references.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, SourceFile
+
+PACKAGE = "fluidframework_tpu"
+
+# subpackage -> subpackages it may import at module level
+ALLOWED = {
+    "analysis": set(),  # the linter depends on nothing it lints
+    "utils": set(),
+    "protocol": {"utils"},
+    "models": {"protocol", "utils", "runtime"},  # runtime: the
+    # SharedObject contract lives in runtime/shared_object (layer 6
+    # sits on the datastore runtime, sharedObject.ts:42)
+    "ops": {"models", "protocol", "utils"},
+    "runtime": {"protocol", "utils"},
+    "drivers": {"protocol", "service", "utils"},  # local/socket
+    # drivers bind to the in-proc/networked service (local-driver ->
+    # local-server in the reference)
+    "loader": {"drivers", "models", "protocol", "runtime", "utils"},
+    "framework": {"drivers", "loader", "models", "runtime",
+                  "service", "utils"},
+    "service": {"models", "native", "ops", "protocol", "utils"},
+    "native": {"ops", "protocol", "service", "utils"},
+    "parallel": {"ops", "utils"},
+    "testing": {"models", "ops", "protocol", "runtime", "service",
+                "utils"},
+    "tools": {"drivers", "loader", "models", "ops", "protocol",
+              "runtime", "service", "testing", "utils"},
+}
+
+# the two sanctioned mutual pairs; excluded from the acyclicity check
+SANCTIONED_CYCLES = {("drivers", "service"), ("native", "service")}
+
+
+def module_level_imports(tree: ast.AST) -> list[ast.stmt]:
+    """Import statements that bind at module import time — skipping
+    TYPE_CHECKING blocks and anything nested inside functions."""
+    out: list[ast.stmt] = []
+
+    def visit_body(body):
+        for stmt in body:
+            if isinstance(stmt, ast.If):
+                if "TYPE_CHECKING" in ast.unparse(stmt.test):
+                    continue
+                visit_body(stmt.body)
+                visit_body(stmt.orelse)
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            elif isinstance(stmt, ast.ClassDef):
+                visit_body(stmt.body)
+            elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                out.append(stmt)
+            elif isinstance(stmt, ast.Try):
+                visit_body(stmt.body)
+                visit_body(stmt.orelse)
+                for h in stmt.handlers:
+                    visit_body(h.body)
+                visit_body(stmt.finalbody)
+
+    visit_body(tree.body)
+    return out
+
+
+def _resolve_targets(stmt: ast.stmt, pkg_parts: list[str]
+                     ) -> list[str]:
+    """Resolve an import statement in module ``PACKAGE/<pkg_parts>``
+    to the top-level subpackages it references (absolute AND relative
+    forms)."""
+    targets = []
+
+    def from_root(names):
+        # `from fluidframework_tpu import service` / `from .. import
+        # service` name subpackages directly — the same edge as the
+        # dotted form and NOT exempt. Names that are not subpackages
+        # are root-facade symbol re-exports (`from .. import fetch`),
+        # which stay "<root>".
+        for alias in names:
+            targets.append(
+                alias.name if alias.name in ALLOWED else "<root>"
+            )
+
+    if isinstance(stmt, ast.Import):
+        for alias in stmt.names:
+            parts = alias.name.split(".")
+            if parts[0] == PACKAGE:
+                targets.append(parts[1] if len(parts) > 1 else "<root>")
+    elif isinstance(stmt, ast.ImportFrom):
+        if stmt.level > 0:
+            # from ..x import y inside PACKAGE/a/b.py: strip
+            # (level-1) trailing dirs from the containing package path
+            up = stmt.level - 1
+            base = pkg_parts[: len(pkg_parts) - up] if up else \
+                list(pkg_parts)
+            mod = (stmt.module or "").split(".")
+            full = [p for p in base + mod if p]
+            if full:
+                targets.append(full[0])
+            else:
+                from_root(stmt.names)
+        elif stmt.module and stmt.module.split(".")[0] == PACKAGE:
+            parts = stmt.module.split(".")
+            if len(parts) > 1:
+                targets.append(parts[1])
+            else:
+                from_root(stmt.names)
+    return targets
+
+
+def edges(files: list[SourceFile]
+          ) -> list[tuple[str, str, str, int]]:
+    """(from_pkg, to_pkg, relpath, line) for every cross-subpackage
+    module-level import edge inside the package."""
+    out = []
+    prefix = PACKAGE + "/"
+    for src in files:
+        if src.tree is None or not src.relpath.startswith(prefix):
+            continue
+        inner = src.relpath[len(prefix):]
+        dir_parts = inner.split("/")[:-1]
+        pkg = dir_parts[0] if dir_parts else "<root>"
+        for stmt in module_level_imports(src.tree):
+            for target in _resolve_targets(stmt, dir_parts):
+                if target != pkg:
+                    out.append((pkg, target, src.relpath, stmt.lineno))
+    return out
+
+
+def declared_cycle() -> list[str]:
+    """Cycles in the DECLARED map beyond the sanctioned pairs (guards
+    the map itself — an edit must not legalize a dependency loop)."""
+    graph = {k: set(v) for k, v in ALLOWED.items()}
+    for a, b in SANCTIONED_CYCLES:
+        graph[a].discard(b)
+    bad: list[str] = []
+    seen: set[str] = set()
+    stack: set[str] = set()
+
+    def dfs(n):
+        if n in stack:
+            bad.append(n)
+            return
+        if n in seen:
+            return
+        stack.add(n)
+        for m in graph.get(n, ()):
+            dfs(m)
+        stack.remove(n)
+        seen.add(n)
+
+    for pkg in graph:
+        dfs(pkg)
+    return bad
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings = []
+    for pkg, target, relpath, line in edges(files):
+        if pkg == "<root>" or target == "<root>":
+            continue  # package facade re-exports
+        if target not in ALLOWED.get(pkg, set()):
+            findings.append(Finding(
+                rule="layer-undeclared",
+                path=relpath, line=line,
+                message=(
+                    f"undeclared layer dependency {pkg} -> {target} "
+                    f"(declared: {sorted(ALLOWED.get(pkg, set()))}); "
+                    "redesign, use a function-local import, or "
+                    "declare the edge in analysis/layercheck.py with "
+                    "justification"
+                ),
+                key=f"{pkg}->{target}",
+            ))
+    for pkg in declared_cycle():
+        findings.append(Finding(
+            rule="layer-cycle", path=f"{PACKAGE}/analysis/layercheck.py",
+            line=1,
+            message=f"declared layer map has a cycle through {pkg!r}",
+            key=f"cycle:{pkg}",
+        ))
+    return findings

@@ -46,8 +46,9 @@ class DebugDocumentService:
         self._allowance = 0          # messages step() still owes
         self._play_to: Optional[int] = None
         self.delivered_seq = 0       # last seq released downstream
-        # breakpoint: pause BEFORE delivering this seq
-        self.break_at: Optional[int] = None
+        # breakpoint: pause BEFORE delivering this seq. Guarded by
+        # _lock (the drain gate reads it); mutate via set_breakpoint.
+        self._break_at: Optional[int] = None
 
     # -- DocumentService surface --------------------------------------
 
@@ -75,11 +76,33 @@ class DebugDocumentService:
         with self._lock:
             return len(self._buffer)
 
+    @property
+    def break_at(self) -> Optional[int]:
+        return self._break_at
+
+    def set_breakpoint(self, seq: Optional[int]) -> None:
+        """Pause BEFORE delivering ``seq`` (None clears). The gate
+        reads the breakpoint under ``_lock`` on the network thread, so
+        an unsynchronized ``break_at`` write from a control thread
+        could be missed by an in-flight drain — this setter is the
+        supported mutation path."""
+        with self._lock:
+            self._break_at = seq
+
     def pause(self) -> None:
+        """Strict stop: beyond gating future releases, recall every
+        released-but-undelivered message from the outbox back to the
+        buffer head (outbox messages precede buffered ones in the
+        fifo, so re-prepending preserves order). A message another
+        thread already popped for delivery cannot be recalled; nothing
+        further leaves after pause() returns."""
         with self._lock:
             self._paused = True
             self._allowance = 0
             self._play_to = None
+            if self._outbox:
+                self._buffer[:0] = self._outbox
+                self._outbox.clear()
 
     def step(self, n: int = 1) -> int:
         """Release up to ``n`` buffered messages; returns how many
@@ -126,8 +149,8 @@ class DebugDocumentService:
         out = []
         while self._buffer:
             head = self._buffer[0]
-            if self.break_at is not None and \
-                    head.sequence_number >= self.break_at:
+            if self._break_at is not None and \
+                    head.sequence_number >= self._break_at:
                 self._allowance = 0
                 self._play_to = None
                 break

@@ -183,11 +183,21 @@ class RemoteOrderingQueue(OrderingQueue):
                     self._close_sock()
                     if attempt:
                         raise
+                except Exception:
+                    # protocol fault (oversized/corrupt length prefix
+                    # -> ValueError, garbage body -> JSONDecodeError):
+                    # the stream position is desynced — the socket
+                    # must never be reused, and retrying would parse
+                    # mid-frame garbage as a fresh frame
+                    self._close_sock()
+                    raise
             if frame.get("type") == "error":
                 raise RuntimeError(frame.get("message", "broker error"))
             return frame
 
     def _close_sock(self) -> None:
+        # caller holds _lock: _sock is lock-guarded (the retry path
+        # in _request swaps it under the same lock)
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -196,7 +206,11 @@ class RemoteOrderingQueue(OrderingQueue):
             self._sock = None
 
     def close(self) -> None:
-        self._close_sock()
+        # take the lock: closing concurrently with an in-flight
+        # _request must not yank the socket mid-recv (waits for the
+        # request to finish instead)
+        with self._lock:
+            self._close_sock()
 
     # -- OrderingQueue surface ----------------------------------------
 

@@ -425,11 +425,20 @@ class AlfredServer:
                 payload["summary"] = encode_contents(latest.summary)
             session.send(payload)
         elif kind == "upload_summary_chunk":
-            # a connection that NEGOTIATED wire 1.0 must not use 1.1
-            # frames; raw upload frames without a prior negotiation
-            # self-evidently speak 1.1 and pass
+            # the upload plane requires a PRIOR connect_document for
+            # the document: the negotiated wire version is what
+            # authorizes 1.1 frames. Un-negotiated frames used to be
+            # waved through as "self-evidently 1.1", which made the
+            # version gate advisory — a client could skip negotiation
+            # entirely and never be held to the compat matrix
+            # (round-5 advisor finding).
             agreed = session.wire_versions.get(doc)
-            if agreed is not None and wire_version_lt(agreed, "1.1"):
+            if agreed is None:
+                raise ValueError(
+                    f"summary upload before connect_document for "
+                    f"{doc!r}: negotiate the wire version first"
+                )
+            if wire_version_lt(agreed, "1.1"):
                 raise ValueError(
                     f"summary upload requires wire version >= 1.1 "
                     f"(connection agreed {agreed})"

@@ -193,12 +193,13 @@ class MaterializedHistoryClient:
                 sock = self._connect()
                 sock.sendall(pack_frame(data))
                 resp = recv_frame_blocking(sock)
-            except (OSError, ConnectionError):
-                self.close()
+            except Exception:
+                # connection faults AND protocol faults (oversized/
+                # corrupt frame -> ValueError/JSONDecodeError): in
+                # either case the stream position is unusable — drop
+                # the socket so the next request reconnects fresh
+                self._close_sock()  # already under _lock
                 raise
-        if resp is None:
-            self.close()
-            raise ConnectionError("MH connection closed")
         if resp.get("type") == "error":
             raise RuntimeError(resp.get("message", "MH error"))
         return resp
@@ -226,12 +227,19 @@ class MaterializedHistoryClient:
             {"type": "branch_get", "guid": guid}
         )["branch"]
 
-    def close(self) -> None:
+    def _close_sock(self) -> None:
+        # caller holds _lock: _sock is swapped under it by _connect
         if self._sock is not None:
             try:
                 self._sock.close()
             finally:
                 self._sock = None
+
+    def close(self) -> None:
+        # lock so a close racing an in-flight _request waits for the
+        # request instead of yanking its socket mid-recv
+        with self._lock:
+            self._close_sock()
 
 
 # ======================================================================

@@ -104,3 +104,98 @@ class FaultInjectionDocumentService:
         for conn in live:
             conn.inject_disconnect()
         return len(live)
+
+
+class ScriptedFrameServer:
+    """TCP stand-in for a framed-protocol peer that misbehaves on cue
+    — the harness for protocol-fault tests (desynced streams, corrupt
+    length prefixes) against the blocking request/response clients
+    (broker's RemoteOrderingQueue, moira's MH client).
+
+    ``script`` is consumed one entry per received request frame:
+    a dict is sent as a well-formed frame; the ``CORRUPT`` sentinel
+    sends an insane length prefix (the poisoned-stream shape). The
+    server keeps accepting reconnects until the script is exhausted,
+    so tests can assert drop-and-reconnect behavior.
+    """
+
+    CORRUPT = object()
+
+    def __init__(self, script):
+        import socket
+        import threading
+
+        self.script = list(script)
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(2)
+        self.port = self._srv.getsockname()[1]
+        self._conns: list = []
+        self._thread = threading.Thread(target=self._serve,
+                                        daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _read_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _serve(self):
+        import struct
+
+        from ..service.ingress import pack_frame
+
+        while self.script:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            try:
+                while self.script:
+                    # consume exactly ONE length-prefixed request per
+                    # script entry: a coalesced or split TCP read must
+                    # not desync scripted replies from requests
+                    header = self._read_exact(conn, 4)
+                    if header is None:
+                        break  # client dropped us: await reconnect
+                    (length,) = struct.unpack(">I", header)
+                    if self._read_exact(conn, length) is None:
+                        break
+                    reply = self.script.pop(0)
+                    if reply is self.CORRUPT:
+                        conn.sendall(struct.pack(">I", 1 << 31))
+                    else:
+                        conn.sendall(pack_frame(reply))
+            except OSError:
+                pass
+
+    def close(self):
+        import socket
+
+        # closing the listener only unblocks accept(); a serve thread
+        # parked in recv() on an accepted connection (client still
+        # attached when a test assertion fails) needs its socket shut
+        # down too or join() stalls its full timeout
+        self._srv.close()
+        for conn in self._conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -285,3 +285,25 @@ print('PRODUCED')
     finally:
         os.kill(broker_proc.pid, signal.SIGKILL)
         broker_proc.wait()
+
+
+def test_corrupt_frame_poisons_socket_and_reconnects_fresh():
+    """A desynced/corrupt length prefix raises ValueError out of the
+    frame reader; the client must DROP the cached socket (reusing it
+    would parse mid-stream garbage as fresh frames) and the next
+    request must reconnect from scratch."""
+    from fluidframework_tpu.testing.fault_injection import (
+        ScriptedFrameServer,
+    )
+
+    meta = {"type": "meta", "n_partitions": 2}
+    with ScriptedFrameServer(
+        [meta, ScriptedFrameServer.CORRUPT, meta]
+    ) as srv:
+        q = RemoteOrderingQueue("127.0.0.1", srv.port, timeout=5.0)
+        with pytest.raises(ValueError, match="exceeds"):
+            q._request({"type": "meta"})
+        assert q._sock is None  # poisoned socket dropped, not cached
+        # next request reconnects and succeeds on the fresh stream
+        assert q._request({"type": "meta"})["n_partitions"] == 2
+        q.close()

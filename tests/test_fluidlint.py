@@ -1,0 +1,521 @@
+"""fluidlint unit tests: per rule family, at least one true-positive
+fixture (the analyzer catches the planted defect) and one clean-pass
+fixture (the idiomatic version sails through) — plus the suppression
+and allowlist machinery. Fixtures are PARSED, never imported, so they
+may reference jax/threading freely without runtime cost.
+"""
+import textwrap
+
+from fluidframework_tpu.analysis.core import (
+    apply_allowlist,
+    run_analysis,
+)
+
+
+def _lint(tmp_path, files, families):
+    for relpath, source in files.items():
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    return run_analysis(
+        roots=sorted({p.split("/")[0] for p in files}),
+        families=families,
+        repo_root=str(tmp_path),
+    )
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------- layercheck
+
+def test_layercheck_flags_undeclared_upward_edge(tmp_path):
+    findings = _lint(tmp_path, {
+        "fluidframework_tpu/protocol/__init__.py": "",
+        "fluidframework_tpu/service/__init__.py": "",
+        # protocol (bottom layer) importing service (top) — every
+        # spelling of the edge must resolve: dotted absolute, dotted
+        # relative, and the root-level forms that name the subpackage
+        # in the import list instead of the module path
+        "fluidframework_tpu/protocol/bad_abs.py": """
+            from fluidframework_tpu.service import broker
+        """,
+        "fluidframework_tpu/protocol/bad_rel.py": """
+            from ..service import broker
+        """,
+        "fluidframework_tpu/protocol/bad_root_abs.py": """
+            from fluidframework_tpu import service
+        """,
+        "fluidframework_tpu/protocol/bad_root_rel.py": """
+            from .. import service
+        """,
+    }, families=["layercheck"])
+    hits = [f for f in findings if f.rule == "layer-undeclared"]
+    assert len(hits) == 4
+    assert all(f.key == "protocol->service" for f in hits)
+    assert {f.path.rsplit("/", 1)[-1] for f in hits} == {
+        "bad_abs.py", "bad_rel.py", "bad_root_abs.py",
+        "bad_root_rel.py",
+    }
+
+
+def test_layercheck_clean_on_declared_and_exempt_imports(tmp_path):
+    findings = _lint(tmp_path, {
+        "fluidframework_tpu/protocol/__init__.py": "",
+        "fluidframework_tpu/utils/__init__.py": "",
+        "fluidframework_tpu/protocol/good.py": """
+            from typing import TYPE_CHECKING
+
+            from ..utils import config          # declared edge
+
+            if TYPE_CHECKING:
+                from ..service import broker    # type-only: exempt
+
+            def lazy():
+                # function-local: cannot create an import cycle
+                from ..service import ingress
+                return ingress
+        """,
+        "fluidframework_tpu/utils/facade_use.py": """
+            from .. import __version__   # root-facade symbol: exempt
+        """,
+    }, families=["layercheck"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------- jaxhazards
+
+def test_jaxhazards_flags_nondeterminism_reached_through_helper(tmp_path):
+    findings = _lint(tmp_path, {
+        "src/kernel.py": """
+            import time
+            import jax
+
+            def _helper(x):
+                return x * time.time()     # nondet, jit-reachable
+
+            @jax.jit
+            def step(x):
+                return _helper(x)
+        """,
+    }, families=["jaxhazards"])
+    assert _rules(findings) == {"jit-nondeterminism"}
+    (hit,) = findings
+    assert "time.time" in hit.message and "_helper" in hit.message
+
+
+def test_jaxhazards_flags_uuid_and_numpy_random(tmp_path):
+    findings = _lint(tmp_path, {
+        "src/kernel.py": """
+            import uuid
+
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def tag(x):
+                salt = uuid.uuid4().int & 0xFF
+                return x + salt + np.random.rand()
+        """,
+    }, families=["jaxhazards"])
+    assert _rules(findings) == {"jit-nondeterminism"}
+    assert {f.key.rsplit(":", 1)[-1] for f in findings} == {
+        "uuid.uuid4", "numpy.random.rand",
+    }
+
+
+def test_jaxhazards_flags_tracer_branch_and_host_callback(tmp_path):
+    findings = _lint(tmp_path, {
+        "src/kernel.py": """
+            import jax
+
+            @jax.jit
+            def relu_ish(x):
+                print("tracing", x)        # host callback
+                if x > 0:                  # python branch on tracer
+                    return x
+                return 0
+        """,
+    }, families=["jaxhazards"])
+    assert _rules(findings) == {
+        "jit-tracer-branch", "jit-host-callback",
+    }
+
+
+def test_jaxhazards_tracks_keyword_only_params(tmp_path):
+    """Kw-only params trace like positional ones: a branch on an
+    unmarked kw-only param is flagged; marking it via static_argnames
+    clears it (and exposes its mutable default)."""
+    findings = _lint(tmp_path, {
+        "src/kernel.py": """
+            from functools import partial
+
+            import jax
+
+            @jax.jit
+            def f(x, *, flag):
+                if flag:                   # traced kw-only: flagged
+                    return x
+                return -x
+
+            @partial(jax.jit, static_argnames=("opts",))
+            def g(x, *, opts=[1]):         # static but unhashable
+                return x
+        """,
+    }, families=["jaxhazards"])
+    assert _rules(findings) == {
+        "jit-tracer-branch", "jit-static-unhashable",
+    }
+
+
+def test_jaxhazards_flags_unhashable_static_default(tmp_path):
+    findings = _lint(tmp_path, {
+        "src/kernel.py": """
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, opts=[1, 2]):
+                return x
+        """,
+    }, families=["jaxhazards"])
+    assert _rules(findings) == {"jit-static-unhashable"}
+
+
+def test_jaxhazards_follows_jitted_lambda_without_param_misfire(tmp_path):
+    """jax.jit(lambda ...) reaches the helper for nondeterminism, but
+    the helper's params bind trace-time-static closure values — no
+    tracer-branch misfire on them."""
+    findings = _lint(tmp_path, {
+        "src/kernel.py": """
+            import random
+
+            import jax
+
+            def _loop(st, k):
+                if k > 1:                  # closure int: static, ok
+                    st = st + random.random()   # nondet: flagged
+                return st
+
+            _cache = {}
+
+            def get_jit(k):
+                if k not in _cache:
+                    _cache[k] = jax.jit(lambda st: _loop(st, k))
+                return _cache[k]
+        """,
+    }, families=["jaxhazards"])
+    assert _rules(findings) == {"jit-nondeterminism"}
+
+
+def test_jaxhazards_clean_on_idiomatic_kernel(tmp_path):
+    findings = _lint(tmp_path, {
+        "src/kernel.py": """
+            import time
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnums=(1,))
+            def step(x, unroll):
+                if unroll > 1:             # static arg: fine
+                    x = x + 1
+                if x is None:              # identity check: trace-time
+                    return 0
+                assert x.capacity < 2**31  # aux-field probe: static
+                jax.debug.print("x={}", x)  # sanctioned debug surface
+                return jax.lax.scan(lambda c, o: (c + o, None), x,
+                                    None, length=unroll)[0]
+
+            def host_timer():
+                return time.time()          # not jit-reachable
+        """,
+    }, families=["jaxhazards"])
+    assert findings == []
+
+
+# ----------------------------------------------------------------- lockcheck
+
+LOCKED_COUNTER_BAD = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def reset(self):
+            self._n = 0        # guarded attr written without the lock
+"""
+
+LOCKED_COUNTER_GOOD = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def reset(self):
+            with self._lock:
+                self._n = 0
+"""
+
+
+def test_lockcheck_flags_unlocked_write(tmp_path):
+    findings = _lint(
+        tmp_path, {"src/counter.py": LOCKED_COUNTER_BAD},
+        families=["lockcheck"],
+    )
+    assert _rules(findings) == {"lock-unlocked-write"}
+    (hit,) = findings
+    assert hit.key == "Counter._n" and "reset" in hit.message
+
+
+def test_lockcheck_sees_annotated_lock_assignment(tmp_path):
+    """`self._lock: threading.Lock = threading.Lock()` (AnnAssign)
+    must register the scope like the plain-assignment form."""
+    src = LOCKED_COUNTER_BAD.replace(
+        "self._lock = threading.Lock()",
+        "self._lock: threading.Lock = threading.Lock()",
+    )
+    findings = _lint(
+        tmp_path, {"src/counter.py": src}, families=["lockcheck"],
+    )
+    assert _rules(findings) == {"lock-unlocked-write"}
+
+
+def test_lockcheck_clean_and_private_helper_propagation(tmp_path):
+    findings = _lint(tmp_path, {
+        "src/counter.py": LOCKED_COUNTER_GOOD,
+        # the _drain_locked shape: a private helper whose every call
+        # site holds the lock writes guarded state lock-free — legal
+        "src/gate.py": """
+            import threading
+
+            class Gate:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._queue = []
+                    self._open = False
+
+                def push(self, item):
+                    with self._lock:
+                        self._queue.append(item)
+                        return self._drain()
+
+                def release(self):
+                    with self._lock:
+                        self._open = True
+                        return self._drain()
+
+                def _drain(self):
+                    out = []
+                    while self._queue and self._open:
+                        out.append(self._queue.pop(0))
+                    return out
+        """,
+    }, families=["lockcheck"])
+    assert findings == []
+
+
+def test_lockcheck_flags_external_write_to_guarded_public_attr(tmp_path):
+    """The break_at shape: a public attribute the owning class only
+    writes under its lock (it exposes a locked setter), mutated raw
+    through an instance elsewhere."""
+    findings = _lint(tmp_path, {
+        "src/player.py": """
+            import threading
+
+            class Player:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.break_seq = None
+                    self._buf = []
+
+                def set_breakpoint(self, seq):
+                    with self._lock:
+                        self.break_seq = seq
+
+                def drain(self):
+                    with self._lock:
+                        if self.break_seq is not None:
+                            return []
+                        return self._buf
+        """,
+        "src/driver_code.py": """
+            def poke(player):
+                player.break_seq = 99    # raw write bypasses the lock
+        """,
+    }, families=["lockcheck"])
+    assert _rules(findings) == {"lock-external-write"}
+    (hit,) = findings
+    assert hit.key == "Player.break_seq"
+    assert hit.path.endswith("driver_code.py")
+
+
+def test_lockcheck_ignores_external_write_to_read_only_config_attr(tmp_path):
+    """Attrs merely READ under a lock (host/timeout config) are not
+    registered: name-based matching would otherwise flag unrelated
+    objects across the tree."""
+    findings = _lint(tmp_path, {
+        "src/client.py": """
+            import threading
+
+            class Client:
+                def __init__(self, timeout):
+                    self._lock = threading.Lock()
+                    self.timeout = timeout
+
+                def request(self):
+                    with self._lock:
+                        return self.timeout * 2
+        """,
+        "src/tweaker.py": """
+            def speed_up(anything):
+                anything.timeout = 0.1   # unrelated object: no finding
+        """,
+    }, families=["lockcheck"])
+    assert findings == []
+
+
+def test_lockcheck_module_level_lock_discipline(tmp_path):
+    findings = _lint(tmp_path, {
+        "src/registry.py": """
+            import threading
+
+            _lock = threading.Lock()
+            _cache = None
+            _error = None
+
+            def load():
+                global _cache
+                with _lock:
+                    if _cache is None:
+                        _cache = _build()
+                    return _cache
+
+            def _build():
+                global _error
+                _error = "probe"   # every call site holds _lock: ok
+                return {}
+
+            def poison():
+                global _cache
+                _cache = None      # bypasses _lock
+        """,
+    }, families=["lockcheck"])
+    assert _rules(findings) == {"lock-unlocked-write"}
+    (hit,) = findings
+    assert "poison" in hit.message and hit.key == "<module>._cache"
+
+
+# ------------------------------------------------- suppression + allowlist
+
+def test_inline_disable_suppresses_exact_rule(tmp_path):
+    src = LOCKED_COUNTER_BAD.replace(
+        "self._n = 0        # guarded attr written without the lock",
+        "self._n = 0  # fluidlint: disable=lock-unlocked-write",
+    )
+    findings = _lint(
+        tmp_path, {"src/counter.py": src}, families=["lockcheck"],
+    )
+    assert findings == []
+    # a different rule id on the same line must NOT suppress
+    src_wrong = LOCKED_COUNTER_BAD.replace(
+        "self._n = 0        # guarded attr written without the lock",
+        "self._n = 0  # fluidlint: disable=layer-undeclared",
+    )
+    findings = _lint(
+        tmp_path, {"src/counter2.py": src_wrong},
+        families=["lockcheck"],
+    )
+    assert _rules(findings) == {"lock-unlocked-write"}
+
+
+def test_inline_disable_with_justification_comment(tmp_path):
+    """The canonical documented form carries a trailing justification
+    (`disable=<rule>  -- why`); the rule id must still parse."""
+    src = LOCKED_COUNTER_BAD.replace(
+        "self._n = 0        # guarded attr written without the lock",
+        "self._n = 0  # fluidlint: disable=lock-unlocked-write"
+        "  -- ctor-adjacent, single-threaded",
+    )
+    findings = _lint(
+        tmp_path, {"src/counter.py": src}, families=["lockcheck"],
+    )
+    assert findings == []
+
+
+def test_inline_disable_multi_rule_with_comma_space(tmp_path):
+    """`disable=rule-a, rule-b  -- why` must keep BOTH rules (a space
+    after the comma must not truncate the list) while the
+    justification text is never parsed as a rule id."""
+    from fluidframework_tpu.analysis.core import SourceFile
+
+    path = tmp_path / "mod.py"
+    path.write_text(
+        "x = 1  # fluidlint: disable=rule-a, rule-b  -- why\n"
+    )
+    parsed = SourceFile(str(path), repo_root=str(tmp_path))
+    assert parsed.suppressed("rule-a", 1)
+    assert parsed.suppressed("rule-b", 1)
+    assert not parsed.suppressed("why", 1)
+    assert not parsed.suppressed("--", 1)
+    # natural spacing after '=' must not void the directive
+    spaced = tmp_path / "spaced.py"
+    spaced.write_text("x = 1  # fluidlint: disable= rule-c\n")
+    parsed = SourceFile(str(spaced), repo_root=str(tmp_path))
+    assert parsed.suppressed("rule-c", 1)
+
+
+def test_allowlist_filters_and_reports_stale(tmp_path):
+    findings = _lint(
+        tmp_path, {"src/counter.py": LOCKED_COUNTER_BAD},
+        families=["lockcheck"],
+    )
+    kept, stale = apply_allowlist(
+        findings,
+        [("lock-unlocked-write", "Counter._n"),   # matches: filtered
+         ("lock-unlocked-write", "Gone.attr")],   # stale: reported
+    )
+    assert kept == []
+    assert stale == [("lock-unlocked-write", "Gone.attr")]
+
+
+def test_nonexistent_scan_path_is_an_error_not_a_clean_pass(tmp_path):
+    """A typo'd path must not report a clean tree with exit 0: CI
+    wired against a misspelled directory would pass forever while
+    scanning nothing."""
+    import pytest
+
+    from fluidframework_tpu.analysis.__main__ import main
+
+    with pytest.raises(ValueError, match="no_such_dir"):
+        run_analysis(roots=["no_such_dir"], repo_root=str(tmp_path))
+    assert main(["fluidframework_tpu/no_such_file.py"]) == 2
+
+
+def test_partial_path_scan_does_not_enforce_allowlist_staleness(
+        tmp_path, monkeypatch):
+    """An allowlist entry living outside the scanned paths must not
+    fail a single-file CLI run as 'stale' — staleness is only
+    meaningful on a full default-roots scan."""
+    from fluidframework_tpu.analysis import __main__ as cli
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    allow = tmp_path / "allow.txt"
+    allow.write_text("lock-unlocked-write Elsewhere.attr\n")
+    monkeypatch.setattr(cli, "REPO_ROOT", str(tmp_path))
+    assert cli.main([str(clean), "--allowlist", str(allow)]) == 0

@@ -1,0 +1,63 @@
+"""The fluidlint gate: every pass family over the whole repo, wired
+into tier-1 so the analyzer's invariants hold forever after.
+
+Green means: zero non-allowlisted findings AND zero stale allowlist
+entries (the ratchet — grandfathered findings may only disappear,
+never accumulate; see docs/ANALYSIS.md for the policy).
+"""
+import json
+import subprocess
+import sys
+
+from fluidframework_tpu.analysis import core
+
+# the ratchet cap (acceptance: <= 10 grandfathered findings). This
+# number may be LOWERED as entries burn down; never raised.
+MAX_ALLOWLIST_ENTRIES = 10
+
+
+def _gate():
+    findings = core.run_analysis()
+    allowlist = core.load_allowlist()
+    kept, stale = core.apply_allowlist(findings, allowlist)
+    return kept, stale, allowlist
+
+
+def test_fluidlint_gate_is_clean():
+    kept, stale, _ = _gate()
+    problems = [f.format() for f in kept]
+    problems += [
+        f"stale allowlist entry '{rule} {key}' matches no live "
+        "finding — delete it from analysis/allowlist.txt"
+        for rule, key in stale
+    ]
+    assert not problems, (
+        "fluidlint gate failed (fix the code, add a justified "
+        "'# fluidlint: disable=<rule>' inline, or — for pre-existing "
+        "debt only — allowlist it):\n" + "\n".join(problems)
+    )
+
+
+def test_allowlist_ratchet_cap():
+    allowlist = core.load_allowlist()
+    assert len(allowlist) <= MAX_ALLOWLIST_ENTRIES, (
+        f"allowlist has {len(allowlist)} entries, cap is "
+        f"{MAX_ALLOWLIST_ENTRIES}: the list only ratchets DOWN — fix "
+        "findings instead of grandfathering new ones"
+    )
+
+
+def test_cli_json_mode_exits_zero_on_clean_tree():
+    """The `--json` surface BENCH/ADVICE tooling consumes: exit 0 and
+    a well-formed empty report on a clean tree."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluidframework_tpu.analysis",
+         "--json"],
+        capture_output=True, text=True, cwd=core.REPO_ROOT,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["findings"] == []
+    assert report["stale_allowlist"] == []
+    assert sorted(report["families"]) == sorted(core.FAMILIES)

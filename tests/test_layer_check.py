@@ -1,137 +1,53 @@
 """Dependency layering enforcement — the build-tools layer-check
 analogue (reference: build-tools/packages/build-tools/src/layerCheck,
-cited in README.md:79-81: layering is machine-enforced, not aspirational).
+cited in README.md:79-81: layering is machine-enforced, not
+aspirational).
 
-Module-level imports between subpackages must stay within the declared
-architecture; TYPE_CHECKING-only and function-local imports are
-exempt (they cannot create import cycles). A NEW upward edge fails
-this test and must either be redesigned or explicitly added here with
-justification.
+The declared map lives in fluidframework_tpu/analysis/layercheck.py —
+ONE source of truth shared with the fluidlint CLI (`python -m
+fluidframework_tpu.analysis`), so this tier-1 test and the linter
+cannot drift apart. Module-level imports between subpackages must stay
+within the declared architecture; TYPE_CHECKING-only and
+function-local imports are exempt (they cannot create import cycles).
+A NEW upward edge fails this test and must either be redesigned or
+explicitly added to the shared ALLOWED map with justification.
 """
-import ast
-import os
-
-import fluidframework_tpu
-
-ROOT = os.path.dirname(fluidframework_tpu.__file__)
-
-# subpackage -> subpackages it may import at module level
-ALLOWED = {
-    "utils": set(),
-    "protocol": {"utils"},
-    "models": {"protocol", "utils", "runtime"},  # runtime: the
-    # SharedObject contract lives in runtime/shared_object (layer 6
-    # sits on the datastore runtime, sharedObject.ts:42)
-    "ops": {"models", "protocol", "utils"},
-    "runtime": {"protocol", "utils"},
-    "drivers": {"protocol", "service", "utils"},  # local/socket
-    # drivers bind to the in-proc/networked service (local-driver ->
-    # local-server in the reference)
-    "loader": {"drivers", "models", "protocol", "runtime", "utils"},
-    "framework": {"drivers", "loader", "models", "runtime",
-                  "service", "utils"},
-    "service": {"models", "native", "ops", "protocol", "utils"},
-    "native": {"ops", "protocol", "service", "utils"},
-    "parallel": {"ops", "utils"},
-    "testing": {"models", "ops", "protocol", "runtime", "service",
-                "utils"},
-    "tools": {"drivers", "loader", "models", "ops", "protocol",
-              "runtime", "service", "testing", "utils"},
-}
-
-
-def _module_level_imports(path):
-    """(package-relative) import edges, skipping TYPE_CHECKING blocks
-    and anything nested inside functions/methods."""
-    tree = ast.parse(open(path).read())
-    out = []
-
-    def visit_body(body):
-        for stmt in body:
-            if isinstance(stmt, ast.If):
-                test = ast.unparse(stmt.test)
-                if "TYPE_CHECKING" in test:
-                    continue
-                visit_body(stmt.body)
-                visit_body(stmt.orelse)
-            elif isinstance(stmt, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef)):
-                continue
-            elif isinstance(stmt, ast.ClassDef):
-                visit_body(stmt.body)
-            elif isinstance(stmt, ast.ImportFrom):
-                out.append(stmt)
-            elif isinstance(stmt, ast.Try):
-                visit_body(stmt.body)
-                visit_body(stmt.orelse)
-                for h in stmt.handlers:
-                    visit_body(h.body)
-
-    visit_body(tree.body)
-    return out
-
-
-def _edges():
-    edges = set()
-    for dirpath, _dirs, files in os.walk(ROOT):
-        if "__pycache__" in dirpath:
-            continue
-        rel = os.path.relpath(dirpath, ROOT)
-        pkg = rel.split(os.sep)[0] if rel != "." else "<root>"
-        for f in files:
-            if not f.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, f)
-            for node in _module_level_imports(path):
-                target = None
-                if node.level > 0:
-                    parts = [] if rel == "." else rel.split(os.sep)
-                    up = node.level - 1
-                    base = parts[: len(parts) - up] if up else parts
-                    mod = (node.module or "").split(".")
-                    full = [p for p in base + mod if p]
-                    target = full[0] if full else "<root>"
-                elif node.module and node.module.startswith(
-                    "fluidframework_tpu"
-                ):
-                    parts = node.module.split(".")
-                    target = parts[1] if len(parts) > 1 else "<root>"
-                if target and target != pkg:
-                    edges.add((pkg, target, path))
-    return edges
+from fluidframework_tpu.analysis import layercheck
+from fluidframework_tpu.analysis.core import walk_python_files
 
 
 def test_no_undeclared_cross_package_imports():
-    violations = []
-    for pkg, target, path in sorted(_edges()):
-        if pkg == "<root>" or target == "<root>":
-            continue  # package facade re-exports
-        if target not in ALLOWED.get(pkg, set()):
-            violations.append(f"{pkg} -> {target}  ({path})")
-    assert not violations, (
-        "undeclared layer dependencies:\n" + "\n".join(violations)
+    files = walk_python_files(["fluidframework_tpu"])
+    findings = [
+        f for f in layercheck.check(files)
+        if f.rule == "layer-undeclared"
+    ]
+    assert not findings, (
+        "undeclared layer dependencies:\n"
+        + "\n".join(f.format() for f in findings)
     )
 
 
 def test_declared_layers_are_acyclic():
-    graph = {k: set(v) for k, v in ALLOWED.items()}
-    seen, stack = set(), set()
-
-    def dfs(n):
-        if n in stack:
-            raise AssertionError(f"layer cycle through {n!r}")
-        if n in seen:
-            return
-        stack.add(n)
-        for m in graph.get(n, ()):  # noqa: B007
-            dfs(m)
-        stack.remove(n)
-        seen.add(n)
-
     # drivers<->service and service<->native are the two sanctioned
     # mutual pairs in the reference too (local-driver <-> local-server
-    # live in one release group); exclude them from the strict check
-    graph["drivers"].discard("service")
-    graph["native"].discard("service")
-    for pkg in graph:
-        dfs(pkg)
+    # live in one release group); layercheck excludes exactly those
+    # from the strict check
+    assert layercheck.declared_cycle() == []
+
+
+def test_every_subpackage_is_declared():
+    import os
+
+    import fluidframework_tpu
+
+    root = os.path.dirname(fluidframework_tpu.__file__)
+    subpackages = {
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d)) and d != "__pycache__"
+    }
+    undeclared = subpackages - set(layercheck.ALLOWED)
+    assert not undeclared, (
+        f"subpackages missing from the declared layer map: "
+        f"{sorted(undeclared)} — add them to analysis/layercheck.py"
+    )

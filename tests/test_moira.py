@@ -216,3 +216,20 @@ def test_moira_two_process_durable(tmp_path):
     finally:
         proc.kill()
         proc.wait(timeout=10)
+
+
+def test_mh_client_corrupt_frame_drops_socket():
+    """Protocol faults (not just connection faults) must drop the MH
+    client's cached socket: after a corrupt length prefix the stream
+    position is garbage and reuse would return mis-parsed frames."""
+    from fluidframework_tpu.testing.fault_injection import (
+        ScriptedFrameServer,
+    )
+
+    with ScriptedFrameServer([ScriptedFrameServer.CORRUPT]) as srv:
+        client = MaterializedHistoryClient("127.0.0.1", srv.port,
+                                           timeout=5.0)
+        with pytest.raises(ValueError, match="exceeds"):
+            client.get_branch("b")
+        assert client._sock is None  # not cached for reuse
+        client.close()

@@ -43,6 +43,13 @@ def _wait(pred, timeout=10.0):
     return False
 
 
+def _negotiate(svc, client_id="alice"):
+    """The upload plane requires a prior connect_document (the wire
+    version agreed there authorizes 1.1 frames); tests driving raw
+    upload frames must negotiate like any real client."""
+    return svc.connect_to_delta_stream(client_id, lambda m: None)
+
+
 def test_summary_store_stage_commit_roundtrip():
     store = SummaryStore()
     root = store.stage({"a": {"x": 1}, "b": [1, 2]})
@@ -157,10 +164,14 @@ def test_upload_requires_write_scope(alfred):
     server = alfred(tenants=tm)
     ro = sign_token(tenant.key, "acme", "d", "alice",
                     scopes=[SCOPE_READ])
+    # read-mode connect: the doc:read token passes the handshake (and
+    # negotiates the wire version the upload plane now requires), then
+    # the upload itself must still be rejected for missing doc:write
     svc = SocketDocumentService("127.0.0.1", server.port, "d",
                                 timeout=15.0, tenant_id="acme",
-                                token=ro)
+                                token=ro, mode="read")
     try:
+        _negotiate(svc)
         with pytest.raises(PermissionError, match="write"):
             svc.upload_summary({"runtime": {}})
     finally:
@@ -172,6 +183,7 @@ def test_upload_out_of_order_chunk_rejected(alfred):
     svc = SocketDocumentService("127.0.0.1", server.port, "d",
                                 timeout=15.0)
     try:
+        _negotiate(svc)
         svc._request({
             "type": "upload_summary_chunk", "document_id": "d",
             "upload_id": "u1", "chunk": 0, "total": 3,
@@ -294,6 +306,7 @@ def test_upload_concurrency_limit_rejects_new_not_evicts_old(alfred):
     svc = SocketDocumentService("127.0.0.1", server.port, "d",
                                 timeout=15.0)
     try:
+        _negotiate(svc)
         payload = _json.dumps({"runtime": {}})
         for i in range(4):  # MAX_UPLOADS_IN_FLIGHT
             svc._request({
@@ -367,6 +380,7 @@ def test_upload_continuation_of_unknown_id_distinct_error(alfred):
     svc = SocketDocumentService("127.0.0.1", server.port, "d",
                                 timeout=15.0)
     try:
+        _negotiate(svc)
         with pytest.raises(RuntimeError,
                            match="rejected, expired, or never started"):
             svc._request({

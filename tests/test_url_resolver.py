@@ -144,8 +144,9 @@ def test_debug_driver_steps_through_live_stream():
     # play_to a specific sequence number
     dbg.play_to(dbg.delivered_seq + 1)
     assert tw.get_text().startswith(tr.get_text())
-    # breakpoint far ahead doesn't block resume
-    dbg.break_at = 10 ** 9
+    # breakpoint far ahead doesn't block resume (set via the locked
+    # setter — raw break_at writes race the network thread's drain)
+    dbg.set_breakpoint(10 ** 9)
     dbg.resume_live()
     assert _wait(lambda: tr.get_text() == tw.get_text())
     # live now: new writer ops flow straight through

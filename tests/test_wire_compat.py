@@ -162,6 +162,27 @@ def test_mixed_version_clients_collaborate(alfred):
         svc_new.close()
 
 
+def test_unnegotiated_connection_cannot_use_upload_frames(alfred):
+    """A client that never ran connect_document gets a loud rejection
+    for upload frames. Raw frames used to be waved through as
+    "self-evidently 1.1", which made the version gate advisory: a
+    client could skip negotiation and dodge the compat matrix
+    entirely."""
+    server = alfred()
+    svc = SocketDocumentService("127.0.0.1", server.port, "raw",
+                                timeout=15.0)
+    try:
+        with pytest.raises(RuntimeError,
+                           match="before connect_document"):
+            svc._request({
+                "type": "upload_summary_chunk", "document_id": "raw",
+                "upload_id": "u", "chunk": 0, "total": 1,
+                "data": "{}",
+            })
+    finally:
+        svc.close()
+
+
 def test_negotiated_10_connection_cannot_use_upload_frames(alfred):
     """Server-side enforcement: a connection that AGREED 1.0 gets a
     loud error for 1.1 frames (not a silent accept)."""
