@@ -41,7 +41,26 @@ import tempfile
 import time
 
 STAGES = ("probe", "fuzz", "config1", "config2", "config3", "config4",
-          "config5", "config6")
+          "config5", "config6", "config7")
+
+# Machine-readable corpus identity, stamped into EVERY stage record
+# (r5 silently changed the stream mix — flow-mix quarter joined — and
+# broke config2/config5 comparability with r3/r4 behind a docstring
+# note; comparisons must be able to check this field instead).
+# Bump `version` whenever a generator change alters the op mix.
+STREAM_CORPUS = {"generator": "fuzzmix+flowmix", "version": 2,
+                 "changed": "r5: flow-mix quarter joined the corpus"}
+STAGE_CORPUS = {
+    "probe": {"generator": "fuzzmix-tiny", "version": 1},
+    "fuzz": {"generator": "fuzzmix-adversarial", "version": 1},
+    "config1": STREAM_CORPUS,
+    "config2": STREAM_CORPUS,
+    "config3": {"generator": "matrix-synthetic", "version": 1},
+    "config4": {"generator": "tree-fuzz", "version": 1},
+    "config5": STREAM_CORPUS,
+    "config6": {"generator": "ladder-typing", "version": 1},
+    "config7": STREAM_CORPUS,
+}
 
 
 # ======================================================================
@@ -366,6 +385,8 @@ def _kernel_stage(name: str, docs: int, base: int, steps: int,
             compiled_window(),
             (make_table(docs, capacity), batch), best,
         )
+    from fluidframework_tpu.service.tpu_sidecar import default_executor
+
     headline = best if cbest is None else min(best, cbest)
     return {
         "docs": docs,
@@ -376,6 +397,9 @@ def _kernel_stage(name: str, docs: int, base: int, steps: int,
             "chunked" if cbest is not None and cbest < best
             else "sequential-scan"
         ),
+        # what the SERVING path (sidecar) would dispatch on this
+        # backend — the kernel stage measures both executors either way
+        "serving_default_executor": default_executor(),
         "sequential_ops_per_sec": round(real / best, 1),
         "chunked": chunk_rec,
         "cpp_baseline_ops_per_sec": (
@@ -1162,6 +1186,32 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
                 lat.append(time.perf_counter() - tr)
         return sess, total, time.perf_counter() - t0, lat
 
+    # device-lane executor: the sidecar's backend-aware serving route
+    # (chunked on launch-taxed backends, scan elsewhere;
+    # FFTPU_SIDECAR_EXECUTOR overrides) — the pipeline stage must
+    # measure the route serving actually takes, not just the kernel
+    from fluidframework_tpu.service.tpu_sidecar import (
+        CHUNK_K,
+        default_executor,
+    )
+
+    route_executor = default_executor()
+    if route_executor == "chunked":
+        from fluidframework_tpu.ops.merge_chunk import (
+            apply_window_chunked,
+            build_chunked,
+        )
+
+    def _route_apply(table, arrays):
+        if route_executor == "chunked":
+            # chunk compile rides the host half of each round (the
+            # sidecar's pack-time cost, reported via round latency)
+            return apply_window_chunked(
+                table, build_chunked(OpBatch(**arrays), K=CHUNK_K),
+                K=CHUNK_K,
+            )
+        return apply_window(table, OpBatch(**arrays))
+
     def run_pipeline(sync_each_round: bool):
         seqs = make_seqs()
         table = make_table(docs, capacity)
@@ -1183,7 +1233,7 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
             mq.reshape(-1)[rd["flat_dst"]] = row_msn
             arrays["seq"] = sq
             arrays["min_seq"] = mq
-            table = apply_window(table, OpBatch(**arrays))
+            table = _route_apply(table, arrays)
             total += len(row_seq)
             if sync_each_round:
                 _sync(table)
@@ -1260,12 +1310,13 @@ def stage_config5(scale: str, reps: int, cooldown: float) -> dict:
         "sessions": docs * clients,
         "rounds": rounds,
         "serving_route": (
-            "device-xla" if on_tpu
+            f"device-xla/{route_executor}" if on_tpu
             else "host-native-tier" if use_host_tier
             # XLA-on-CPU stand-in for the device kernel — NOT the
             # honest CPU product route (see r4: 0.52x scalar python)
-            else "emulation"
+            else f"emulation/{route_executor}"
         ),
+        "dispatch_executor": route_executor,
         **({"host_tier_error": host_tier_error}
            if host_tier_error else {}),
         "pipeline_ops_per_sec": round(total_real / best, 1),
@@ -1303,8 +1354,12 @@ def stage_config6(scale: str, reps: int, cooldown: float) -> dict:
     }[scale]
 
     server = LocalServer()
+    # pipeline=False: this stage ATTRIBUTES costs to individual rounds
+    # (steady vs compact vs grow vs evict); the pipelined default
+    # defers recovery to the next settle, which would smear an event's
+    # cost into its successor round (config7 measures the pipeline)
     sidecar = TpuMergeSidecar(max_docs=docs, capacity=32,
-                              max_capacity=max_cap)
+                              max_capacity=max_cap, pipeline=False)
     # compile the whole capacity ladder up front (VERDICT r3 #5: the
     # regrow cliff was an XLA-compile cliff; prewarm + the persistent
     # cache turn a warm regrow into ~one steady apply)
@@ -1362,6 +1417,8 @@ def stage_config6(scale: str, reps: int, cooldown: float) -> dict:
     return {
         "docs": docs,
         "rounds": rounds,
+        "dispatch_executor": sidecar.executor,
+        "pipeline": False,
         "prewarm_s": round(prewarm_s, 2),
         "steady_apply_ms_median": round(med, 2) if med else None,
         "steady_apply_ms_p95": round(
@@ -1386,6 +1443,190 @@ def stage_config6(scale: str, reps: int, cooldown: float) -> dict:
     }
 
 
+def stage_config7(scale: str, reps: int, cooldown: float) -> dict:
+    """Dispatch-pipeline overlap (the sidecar serving loop, measured):
+    many docs x small per-round windows — the steady-state serving
+    shape — driven through the REAL TpuMergeSidecar apply path under
+    four configurations:
+
+      pipelined      the serving default: backend-aware executor
+                     route, vectorized pack, deferred settle (host
+                     packs round N+1 while the device computes N)
+      synced         same route, settle every round (per-round
+                     latency percentiles come from this pass)
+      other-route    the escape-hatch executor, synced (chunked vs
+                     scan resolved per backend IN the record, not by
+                     assertion)
+      r5-route       scan + per-round sync + the r5 scalar
+                     per-op-per-field pack loop — the faithful
+                     round-5 serving baseline the speedup is against
+
+    Pack/compute overlap is reported separately: ``host_pack_s`` (the
+    host half) vs ``device_wait_s`` (time blocked in the settle
+    boundary), plus the wall delta the deferred settle actually buys.
+    """
+    import numpy as np
+
+    from fluidframework_tpu.models.mergetree import MergeTreeClient
+    from fluidframework_tpu.ops.host_bridge import OP_FIELDS
+    from fluidframework_tpu.ops.segment_table import KIND_NOOP
+    from fluidframework_tpu.protocol.messages import MessageType
+    from fluidframework_tpu.service import tpu_sidecar as sc_mod
+    from fluidframework_tpu.service.tpu_sidecar import (
+        TpuMergeSidecar,
+        default_executor,
+    )
+
+    docs, base, steps, clients, capacity, round_ops = {
+        "full": (2048, 16, 160, 3, 512, 8),
+        "cpu": (256, 8, 96, 3, 256, 8),
+        "smoke": (32, 4, 40, 2, 128, 8),
+    }[scale]
+    raw, encs = _build_streams(base, steps, clients, seed0=4100)
+    rounds = (max(len(e.ops) for e in encs) + round_ops - 1) \
+        // round_ops
+
+    def legacy_pack(n_rows, ops_by_row, bucket_floor=16):
+        """The r5 _pack_rows: nested per-op per-field Python loops
+        with scalar stores (kept verbatim as the baseline's pack)."""
+        window = max((len(v) for v in ops_by_row.values()), default=0)
+        bucket = bucket_floor
+        while bucket < window:
+            bucket *= 2
+        arrays = {f: np.zeros((n_rows, bucket), np.int32)
+                  for f in OP_FIELDS}
+        arrays["kind"][:] = KIND_NOOP
+        for row, ops in ops_by_row.items():
+            for w, op in enumerate(ops):
+                for f in OP_FIELDS:
+                    arrays[f][row, w] = op[f]
+        return arrays
+
+    def run(executor, pipeline, pack=None, sync_each_round=False):
+        orig_pack = sc_mod._pack_rows
+        if pack is not None:
+            sc_mod._pack_rows = pack
+        try:
+            sidecar = TpuMergeSidecar(
+                max_docs=docs, capacity=capacity,
+                max_capacity=capacity * 4, executor=executor,
+                pipeline=pipeline,
+            )
+            for d in range(docs):
+                slot = sidecar.track(f"doc-{d}", "d", "s")
+                # the canonical stream IS the encoded corpus stream
+                # (payload table included); rounds feed its op slices
+                # through the queue exactly as ingest would
+                sidecar._streams[slot] = encs[d % base]
+            total = 0
+            lat = []
+            t0 = time.perf_counter()
+            for r in range(rounds):
+                tr = time.perf_counter()
+                lo, hi = r * round_ops, (r + 1) * round_ops
+                for d in range(docs):
+                    sl = encs[d % base].ops[lo:hi]
+                    if sl:
+                        sidecar._queued[d].extend(sl)
+                total += sidecar.apply()
+                if sync_each_round:
+                    sidecar.sync()
+                    lat.append(time.perf_counter() - tr)
+            sidecar.sync()
+            np.asarray(sidecar._table.count)  # transfer-forced
+            return sidecar, total, time.perf_counter() - t0, lat
+        finally:
+            sc_mod._pack_rows = orig_pack
+
+    executor = default_executor()
+    other = "scan" if executor == "chunked" else "chunked"
+
+    n_reps = max(2, reps // 2)
+
+    def best_of(fn):
+        # every route gets the SAME best-of-N + cooldown treatment:
+        # comparing a best-of-N headline against single-shot baselines
+        # would bias every ratio (vs_r5_route included) toward the
+        # headline on any one-off GC/thermal hiccup
+        best_w = None
+        keep = None
+        for _ in range(n_reps):
+            time.sleep(min(cooldown, 2.0))
+            out = fn()
+            if best_w is None or out[2] < best_w:
+                best_w, keep = out[2], out
+        return keep
+
+    _, _, warm_s, _ = run(executor, True)         # compile
+    sidecar, total, best, _ = best_of(lambda: run(executor, True))
+    sc_sync, _, wall_sync, lat = best_of(
+        lambda: run(executor, False, sync_each_round=True))
+    run(other, False)                             # compile other route
+    _, _, wall_other, _ = best_of(lambda: run(other, False))
+    _, _, wall_r5, _ = best_of(
+        lambda: run("scan", False, pack=legacy_pack))
+
+    assert sidecar.host_mode_docs() == 0, "config7 unexpected eviction"
+    # parity: served text vs scalar oracle replay, both routes
+    for d in range(min(4, base)):
+        obs = MergeTreeClient("oracle")
+        obs.start_collaboration("oracle")
+        for msg in raw[d % base]:
+            if msg.type == MessageType.OPERATION:
+                obs.apply_msg(msg)
+        want = obs.get_text()
+        assert sidecar.text(f"doc-{d}", "d", "s") == want, (
+            f"config7 pipeline/oracle divergence doc {d}")
+        assert sc_sync.text(f"doc-{d}", "d", "s") == want, (
+            f"config7 synced/oracle divergence doc {d}")
+
+    lat_ms = sorted(x * 1000 for x in lat)
+    pack_s = sidecar.stats["pack_s"]
+    wait_s = sidecar.stats["settle_s"]
+    # honest overlap accounting: the pipelined-vs-synced wall delta
+    # mixes eliminated per-round sync overhead with genuinely hidden
+    # pack time, so hidden pack is CAPPED at the total pack time (it
+    # cannot exceed what there was to hide); the uncapped delta is
+    # reported separately as what the deferred settle bought in toto
+    sync_delta_s = max(0.0, wall_sync - best)
+    pack_hidden_s = min(pack_s, sync_delta_s)
+    return {
+        "docs": docs,
+        "rounds": rounds,
+        "round_ops": round_ops,
+        "dispatch_executor": executor,
+        "pipeline_ops_per_sec": round(total / best, 1),
+        "kernel_ops_per_sec": round(total / best, 1),
+        "synced_ops_per_sec": round(total / wall_sync, 1),
+        f"{other}_route_ops_per_sec": round(total / wall_other, 1),
+        "r5_route_ops_per_sec": round(total / wall_r5, 1),
+        "vs_r5_route": round(wall_r5 / best, 2),
+        "real_ops": total,
+        "best_wall_s": round(best, 3),
+        "compile_run_s": round(warm_s, 2),
+        # pack/compute overlap, separately reported: the host half,
+        # the time actually blocked at the settle boundary (the
+        # device-bound share of the pipelined wall), the total wall
+        # the deferred settle bought, and the pack time hidden by it
+        # (capped at host_pack_s — the delta also contains eliminated
+        # sync overhead, which is NOT overlap)
+        "host_pack_s": round(pack_s, 3),
+        "device_wait_s": round(wait_s, 3),
+        "device_bound_pct": round(100 * wait_s / best, 1),
+        "sync_elimination_s": round(sync_delta_s, 3),
+        "pack_hidden_s": round(pack_hidden_s, 3),
+        "pack_hidden_pct": round(
+            100 * pack_hidden_s / pack_s, 1) if pack_s else None,
+        "round_latency_p50_ms": round(
+            _pct(lat_ms, 0.5), 2) if lat_ms else None,
+        "round_latency_p99_ms": round(
+            _pct(lat_ms, 0.99), 2) if lat_ms else None,
+        "p50_ms": round(_pct(lat_ms, 0.5), 2) if lat_ms else None,
+        "p99_ms": round(_pct(lat_ms, 0.99), 2) if lat_ms else None,
+        "parity": f"text-verified x{min(4, base)} x2 routes",
+    }
+
+
 STAGE_FNS = {
     "probe": stage_probe,
     "fuzz": stage_fuzz,
@@ -1395,6 +1636,7 @@ STAGE_FNS = {
     "config4": stage_config4,
     "config5": stage_config5,
     "config6": stage_config6,
+    "config7": stage_config7,
 }
 
 
@@ -1408,6 +1650,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
     result.update({
         "backend": jax.default_backend(),
         "scale": scale,
+        "corpus": STAGE_CORPUS.get(name),
         "stage_elapsed_s": round(time.perf_counter() - t0, 1),
     })
     # persist the full-scale result BEFORE the fixed-scale companion:
@@ -1427,6 +1670,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         # readable (VERDICT r2 weak #9)
         t1 = time.perf_counter()
         fixed = STAGE_FNS[name]("cpu", max(1, reps // 2), 0.5)
+        fixed["corpus"] = STAGE_CORPUS.get(name)
         fixed["stage_elapsed_s"] = round(time.perf_counter() - t1, 1)
         result["fixed_scale"] = fixed
         with open(out_path, "w") as f:

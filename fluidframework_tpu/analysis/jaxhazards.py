@@ -50,6 +50,31 @@ HOST_CALLBACKS = (
     "jax.experimental.host_callback.",
 )
 
+# host<->device sync points: a call that forces a device->host
+# transfer (or blocks on device completion) serializes the dispatch
+# pipeline it appears in
+SYNC_PREFIXES = (
+    "numpy.asarray",
+    "jax.device_get",
+)
+SYNC_METHODS = ("block_until_ready",)
+
+# Dispatch-loop registry for the ``dispatch-loop-sync`` rule: module
+# (relpath suffix) -> (loop root functions, designated sync-boundary
+# functions). The sidecar's apply loop is a host/device pipeline whose
+# ONLY sanctioned sync is ``_settle`` (where the overflow flag is read
+# and recovery runs — service/tpu_sidecar.py); any np.asarray /
+# device_get / block_until_ready reachable from the loop outside that
+# boundary re-serializes packing against device compute and silently
+# un-pipelines serving.
+DISPATCH_LOOPS = {
+    "service/tpu_sidecar.py": (
+        ("apply", "_dispatch", "_pack_rows", "_compile_program",
+         "_apply_program"),
+        ("_settle", "sync"),
+    ),
+}
+
 
 def _import_aliases(tree: ast.AST) -> dict[str, str]:
     """local name -> dotted path, from every import in the module
@@ -238,8 +263,82 @@ def _names_in(node: ast.AST) -> list[ast.Name]:
     ]
 
 
+def _check_dispatch_loops(files: list[SourceFile],
+                          loops: dict = DISPATCH_LOOPS
+                          ) -> list[Finding]:
+    """``dispatch-loop-sync``: host<->device sync points inside a
+    registered dispatch loop, outside its designated sync boundary.
+    Reachability is module-local over bare-name calls AND
+    ``self.<name>()`` method calls (the loops are methods); traversal
+    prunes at the boundary functions — syncing there is the design."""
+    findings: list[Finding] = []
+    for src in files:
+        if src.tree is None:
+            continue
+        cfg = next(
+            (v for suffix, v in loops.items()
+             if src.relpath.endswith(suffix)),
+            None,
+        )
+        if cfg is None:
+            continue
+        root_names, boundary = cfg
+        aliases = _import_aliases(src.tree)
+        module = src.relpath.rsplit("/", 1)[-1]
+        by_name: dict[str, list[ast.FunctionDef]] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        seen: dict[int, ast.FunctionDef] = {}
+        queue = [fn for name in root_names
+                 for fn in by_name.get(name, [])]
+        while queue:
+            fn = queue.pop()
+            if id(fn) in seen:
+                continue
+            seen[id(fn)] = fn
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                elif isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self":
+                    callee = node.func.attr
+                if callee is not None and callee not in boundary:
+                    queue.extend(by_name.get(callee, []))
+        for fn in seen.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func, aliases)
+                hit = None
+                if dotted is not None and _matches(dotted, SYNC_PREFIXES):
+                    hit = dotted
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in SYNC_METHODS:
+                    hit = node.func.attr
+                if hit is not None:
+                    findings.append(Finding(
+                        rule="dispatch-loop-sync",
+                        path=src.relpath, line=node.lineno,
+                        message=(
+                            f"{hit}() inside dispatch-loop "
+                            f"{fn.name}() outside the designated "
+                            f"sync boundary {boundary}: a host<->"
+                            "device sync here re-serializes host "
+                            "packing against device compute — move "
+                            "the read into the settle boundary"
+                        ),
+                        key=f"{module}:{fn.name}:{hit}",
+                    ))
+    return findings
+
+
 def check(files: list[SourceFile]) -> list[Finding]:
-    findings = []
+    findings = _check_dispatch_loops(files)
     for src in files:
         if src.tree is None:
             continue

@@ -46,8 +46,9 @@ _LEN = struct.Struct(">I")
 
 # wire versions this driver speaks, newest first (the server echoes
 # the agreed one in "connected"; see ingress.WIRE_VERSIONS for what
-# each version adds — 1.1 is the chunked summary-upload plane)
-WIRE_VERSIONS = ("1.1", "1.0")
+# each version adds — 1.1 is the chunked summary-upload plane, 1.2 the
+# boxcarred batch submit)
+WIRE_VERSIONS = ("1.2", "1.1", "1.0")
 
 
 def build_connect_frame(document_id: str, client_id: str, mode: str,
@@ -190,8 +191,38 @@ class SocketDocumentService:
             frame = self._inbox.get()
             if frame is None:
                 break
-            with self.lock:
-                self._deliver(frame)
+            try:
+                with self.lock:
+                    self._deliver(frame)
+            except Exception:  # noqa: BLE001 - must fail LOUDLY
+                # A delivery callback raising used to kill this thread
+                # SILENTLY: every later broadcast (including the acks
+                # of ops already submitted) was dropped and the
+                # container waited on pending ops forever — the exact
+                # shape of the round-5 ~1-in-3 whiteboard stall (a
+                # foreign op sequenced mid-batch tripped the
+                # ScheduleManager assert here). Continuing to deliver
+                # would be no better: the fault may have torn the
+                # runtime mid-message, and feeding it further ops
+                # serves silently-divergent state. Fail LOUDLY and
+                # DETECTABLY instead: record the fault, print it, and
+                # tear the transport down — the app layer reconnects
+                # and the pending-state machinery resubmits exactly
+                # (the same recovery path a dropped connection takes).
+                import traceback
+
+                err = (
+                    f"dispatch fault on {frame.get('type')!r}: "
+                    f"{traceback.format_exc()}"
+                )
+                with self.lock:
+                    self.last_error = err
+                print(
+                    f"socket-driver[{self.document_id}]: {err}",
+                    file=sys.stderr,
+                )
+                self.close()
+                break
 
     def _on_connected(self, frame: dict) -> None:
         """Handshake-ack hook (the multiplexing subclass routes by
@@ -395,25 +426,66 @@ class SocketDocumentService:
 
 
 class SocketDeltaConnection:
-    """IDocumentDeltaConnection over the wire."""
+    """IDocumentDeltaConnection over the wire.
+
+    BATCH BOXCARRING (wire >= 1.2): a runtime batch (ops between a
+    ``{"batch": true}`` and ``{"batch": false}`` metadata mark) is
+    buffered here and sent as ONE ``submitOp`` frame carrying the op
+    array. This is the liveness fix for the round-5 ~1-in-3
+    submit->ack stall: per-op frames from two TCP sessions interleave
+    on the server's event loop, so another client's op could be
+    SEQUENCED in the middle of this client's batch — receivers'
+    ScheduleManager treats a foreign op mid-batch as a service
+    ordering violation (it is one) and the replica stops acking. The
+    reference never has this problem because a socket.io submitOp
+    carries the whole batch array and alfred tickets it atomically;
+    this restores that contract. Against a pre-1.2 server the driver
+    degrades to per-op frames (the legacy racy behavior, for the
+    compat matrix)."""
 
     def __init__(self, service: SocketDocumentService, client_id: str):
         self._service = service
         self.client_id = client_id
         self.open = True
+        self._batch: list[dict] = []
+        self._batching = False
+
+    def _boxcar_capable(self) -> bool:
+        agreed = self._service.agreed_version
+        return agreed is not None and not wire_version_lt(agreed, "1.2")
 
     def submit(self, op: DocumentMessage) -> None:
         assert self.open, "submit on closed connection"
+        from ..protocol.constants import batch_flag
+
+        wire = document_message_to_json(op)
+        flag = batch_flag(op.metadata)
+        if self._boxcar_capable() and (self._batching or flag is True):
+            self._batch.append(wire)
+            self._batching = flag is not False
+            if self._batching:
+                return
+            ops, self._batch = self._batch, []
+            self._service._send({
+                "type": "submitOp",
+                "document_id": self._service.document_id,
+                "ops": ops,
+            })
+            return
         self._service._send({
             "type": "submitOp",
             "document_id": self._service.document_id,
-            "op": document_message_to_json(op),
+            "op": wire,
         })
 
     def disconnect(self) -> None:
         if not self.open:
             return
         self.open = False
+        # an unterminated batch dies with the connection: its ops stay
+        # in the runtime's pending state and resubmit on reconnect
+        self._batch = []
+        self._batching = False
         try:
             self._service._send({
                 "type": "disconnect_document",

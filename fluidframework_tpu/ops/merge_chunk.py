@@ -218,7 +218,18 @@ def compile_chunks(arrays: dict, k_max: int = 8) -> dict:
     pred = np.full((D, W), -1, np.int32)
     ev_cover = np.zeros((D, W), np.int32)
 
-    for d in range(D):
+    # All-NOOP rows (idle documents in a serving dispatch — the common
+    # case for the sidecar's sparse windows) need no chain analysis:
+    # their chunk pattern is a boundary every k_max lanes, emitted
+    # vectorized. The Python compiler loop below then touches only the
+    # rows that actually carry ops, so pack-time cost scales with real
+    # traffic, not with the doc axis.
+    active = np.flatnonzero((kind != KIND_NOOP).any(axis=1))
+    idle_mask = np.ones(D, np.bool_)
+    idle_mask[active] = False
+    chunk_start[idle_mask, ::k_max] = 1
+
+    for d in active:
         chains: dict[int, _Chain] = {}
         chunk: list[int] = []   # window indices of the open chunk
         base_w = 0              # chunk start window index
@@ -842,6 +853,45 @@ def _get_jit(K: int):
             lambda st, ops: _window_loop(st, ops, K)
         )
     return _jit_cache[K]
+
+
+_jit_pingpong_cache: dict = {}
+
+
+def _get_jit_pingpong(K: int):
+    if K not in _jit_pingpong_cache:
+
+        def run(dead: dict, st: dict, ops: dict) -> dict:
+            # ``dead`` is donation fodder (a retired same-shape state):
+            # its buffers may back this window's output. Never read.
+            del dead
+            return _window_loop(st, ops, K)
+
+        _jit_pingpong_cache[K] = jax.jit(run, donate_argnums=(0,))
+    return _jit_pingpong_cache[K]
+
+
+def apply_window_chunked_pingpong(dead: SegmentTable | None,
+                                  table: SegmentTable, chunked: dict,
+                                  K: int = 8) -> SegmentTable:
+    """Double-buffered twin of ``apply_window_chunked``: DONATES
+    ``dead`` (a retired table of the same shape, e.g. the state two
+    dispatches old) so XLA can reuse its buffers for the output while
+    ``table`` survives as the caller's pre-dispatch snapshot — the
+    sidecar's O(window) overflow regrow depends on that snapshot
+    staying alive, which is why the live input is never the donated
+    one. The caller must drop every reference to ``dead``. Degrades to
+    the plain dispatch when ``dead`` is None or the backend (CPU) has
+    no donation support."""
+    if dead is None or jax.default_backend() == "cpu":
+        return apply_window_chunked(table, chunked, K=K)
+    st = _chunk_state(table)
+    ops_w = {
+        f: jnp.asarray(chunked[f])
+        for f in OpBatch._fields + CHUNK_FIELDS
+    }
+    st = _get_jit_pingpong(K)(_chunk_state(dead), st, ops_w)
+    return _chunk_unstate(dict(st))
 
 
 def compiled_window(table: SegmentTable, chunked: dict, K: int = 8):

@@ -94,12 +94,47 @@ def apply_window_impl(table: SegmentTable, batch: OpBatch) -> SegmentTable:
     return state_to_table(st, SegmentTable)
 
 
-# NO donate_argnums: donation serializes back-to-back windows on the
-# axon runtime. NOTE on timing this path: block_until_ready through
-# the axon tunnel returns at dispatch, NOT completion — any honest
-# measurement must force a device->host transfer (np.asarray of an
-# output) to include the compute (bench.py does).
+# NO donate_argnums on the PLAIN dispatch: donating the live input
+# table serializes back-to-back windows on the axon runtime (the next
+# window's input IS the previous output, so the runtime must wait for
+# the buffer release before enqueueing). Donation rides the PING-PONG
+# form below instead. NOTE on timing this path: block_until_ready
+# through the axon tunnel returns at dispatch, NOT completion — any
+# honest measurement must force a device->host transfer (np.asarray
+# of an output) to include the compute (bench.py does).
 _apply_window_xla = jax.jit(apply_window_impl)
+
+
+def _pingpong_impl(dead: SegmentTable, table: SegmentTable,
+                   batch: OpBatch) -> SegmentTable:
+    # ``dead`` is donation fodder only: a table two dispatches old
+    # whose buffers XLA may reuse for this window's output. It is
+    # never read — donating the LIVE input would forbid keeping the
+    # pre-dispatch snapshot the sidecar's O(window) regrow needs.
+    del dead
+    return apply_window_impl(table, batch)
+
+
+_apply_window_pingpong = jax.jit(_pingpong_impl, donate_argnums=(0,))
+
+
+def apply_window_pingpong(dead: SegmentTable, table: SegmentTable,
+                          batch: OpBatch) -> SegmentTable:
+    """Double-buffered dispatch: apply ``batch`` to ``table`` while
+    DONATING ``dead`` (a retired same-shape table) as the output
+    buffer. This re-enables donation safely for back-to-back windows:
+    round N+1 donates the round N-1 snapshot, which is provably free
+    by the time N+1's output materializes (round N's input depended on
+    it), so no serialization — and ``table`` survives as the
+    pre-dispatch snapshot for overflow regrow. The caller must drop
+    every reference to ``dead`` (its buffers are consumed).
+
+    On backends without donation support (CPU) this silently degrades
+    to the plain dispatch — same results, no buffer reuse."""
+    if jax.default_backend() == "cpu":
+        # CPU ignores donation with a per-call warning; skip the noise
+        return _apply_window_xla(table, batch)
+    return _apply_window_pingpong(dead, table, batch)
 
 
 def compiled_window():

@@ -51,7 +51,8 @@ def global_window_floor(min_seq, mesh: Mesh):
     doc shards are otherwise independent vmap lanes.
     """
     import jax.numpy as jnp
-    from jax import shard_map
+
+    from .seq_shard import shard_map  # top-level/experimental shim
 
     def local(ms):  # [docs_shard] on each device
         return jax.lax.pmin(jnp.min(ms), DOC_AXIS)

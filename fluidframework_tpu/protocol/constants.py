@@ -29,3 +29,22 @@ def wire_version_lt(a: str, b: str) -> bool:
     orders above '1.2'."""
     return tuple(int(x) for x in a.split(".")) < \
         tuple(int(x) for x in b.split("."))
+
+
+def mark_batch(metadata, flag: bool) -> dict:
+    """Batch boundary marks riding message metadata
+    (batchManager.ts batch metadata: first op {batch: true}, last
+    {batch: false}; singletons carry no mark). Lives at the protocol
+    layer: the marks are a WIRE contract — the runtime writes them,
+    the loader's ScheduleManager and the socket driver's boxcar
+    batching both read them."""
+    out = dict(metadata) if isinstance(metadata, dict) else {}
+    out["batch"] = flag
+    return out
+
+
+def batch_flag(metadata):
+    """Read a batch boundary mark (None = unmarked / mid-batch)."""
+    if isinstance(metadata, dict):
+        return metadata.get("batch")
+    return None

@@ -156,16 +156,7 @@ def stage_outbound(envelope: dict, compressor: OpCompressor,
     return splitter.split_encoded(envelope, payload)
 
 
-def mark_batch(metadata: Any, flag: bool) -> dict:
-    """Batch boundary marks riding message metadata
-    (batchManager.ts batch metadata: first op {batch: true}, last
-    {batch: false}; singletons carry no mark)."""
-    out = dict(metadata) if isinstance(metadata, dict) else {}
-    out["batch"] = flag
-    return out
-
-
-def batch_flag(metadata: Any) -> Optional[bool]:
-    if isinstance(metadata, dict):
-        return metadata.get("batch")
-    return None
+# batch boundary marks moved to the protocol layer (they are a wire
+# contract the drivers also consume); re-exported here for the
+# runtime-side users
+from ..protocol.constants import batch_flag, mark_batch  # noqa: E402,F401

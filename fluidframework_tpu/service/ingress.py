@@ -77,7 +77,12 @@ MAX_FRAME = 64 * 1024 * 1024
 #       must not use 1.1 frames (server rejects them; the driver
 #       degrades to inline summaries — the old-client/new-service
 #       pairing of the compat matrix, tests/test_wire_compat.py).
-WIRE_VERSIONS = ("1.1", "1.0")
+# 1.2 — adds the boxcarred batch submit: one submitOp frame may carry
+#       "ops": [<DocumentMessage>...] and the whole array tickets
+#       atomically on the event loop, so a runtime batch can never be
+#       interleaved with another session's ops in the sequenced order
+#       (the submit->ack liveness fix — see SocketDeltaConnection).
+WIRE_VERSIONS = ("1.2", "1.1", "1.0")
 
 
 def document_message_to_json(op: DocumentMessage) -> dict:
@@ -389,19 +394,41 @@ class AlfredServer:
             })
         elif kind == "submitOp":
             conn = session.connections[doc]
-            try:
-                conn.submit(document_message_from_json(frame["op"]))
-            except PermissionError as e:
-                # read-mode connection: reject as a NACK so the driver's
-                # on_nack fires (parity with the in-proc path, which
-                # raises to the caller directly)
-                session.send({
-                    "type": "nack", "document_id": doc,
-                    "operation": frame["op"],
-                    "sequence_number": 0,
-                    "error_type": int(NackErrorType.INVALID_SCOPE),
-                    "message": str(e),
-                })
+            # "ops" (wire >= 1.2) = one boxcarred batch. This handler
+            # runs synchronously on the event loop with no awaits, so
+            # the array tickets as one contiguous seq run — no other
+            # session's frame can interleave a foreign op mid-batch
+            # (the reference's alfred handles socket.io message arrays
+            # the same way).
+            boxcar = frame.get("ops")
+            if boxcar is not None and wire_version_lt(
+                    session.wire_versions.get(doc, "1.0"), "1.2"):
+                raise ValueError(
+                    "boxcarred submit requires wire version >= 1.2 "
+                    f"(connection agreed "
+                    f"{session.wire_versions.get(doc, '1.0')})"
+                )
+            ops_json = boxcar if boxcar is not None else [frame["op"]]
+            # decode the WHOLE array before submitting anything: a
+            # malformed op mid-boxcar must fail the batch as a unit
+            # (error frame, nothing sequenced) — partially ticketing
+            # it would put a torn batch on the wire, the exact state
+            # the boxcar protocol exists to rule out
+            decoded = [document_message_from_json(o) for o in ops_json]
+            for op_json, op in zip(ops_json, decoded):
+                try:
+                    conn.submit(op)
+                except PermissionError as e:
+                    # read-mode connection: reject as a NACK so the
+                    # driver's on_nack fires (parity with the in-proc
+                    # path, which raises to the caller directly)
+                    session.send({
+                        "type": "nack", "document_id": doc,
+                        "operation": op_json,
+                        "sequence_number": 0,
+                        "error_type": int(NackErrorType.INVALID_SCOPE),
+                        "message": str(e),
+                    })
         elif kind == "read_ops":
             self._check_read_access(session, doc, frame)
             msgs = self.local.read_ops(

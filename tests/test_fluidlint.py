@@ -236,6 +236,81 @@ def test_jaxhazards_clean_on_idiomatic_kernel(tmp_path):
     assert findings == []
 
 
+def test_jaxhazards_flags_sync_inside_dispatch_loop(tmp_path):
+    """dispatch-loop-sync: a host<->device sync reachable from the
+    sidecar's apply loop outside the _settle boundary — including one
+    reached through a self-method hop — is a pipeline serializer."""
+    findings = _lint(tmp_path, {
+        "fluidframework_tpu/service/tpu_sidecar.py": """
+            import numpy as np
+            import jax
+
+            class Sidecar:
+                def apply(self):
+                    return self._dispatch()
+
+                def _dispatch(self):
+                    arrays = self._pack()
+                    if np.asarray(self._table.overflow).any():  # BAD
+                        self._recover()
+                    out = self._step(arrays)
+                    out.block_until_ready()                     # BAD
+                    return jax.device_get(out)                  # BAD
+
+                def _pack(self):
+                    return np.zeros((4, 4))  # host numpy: fine
+
+                def _settle(self):
+                    # the designated boundary: syncing here is the
+                    # design, not a finding
+                    return np.asarray(self._table.overflow).any()
+        """,
+    }, families=["jaxhazards"])
+    hits = [f for f in findings if f.rule == "dispatch-loop-sync"]
+    assert {f.key for f in hits} == {
+        "tpu_sidecar.py:_dispatch:numpy.asarray",
+        "tpu_sidecar.py:_dispatch:block_until_ready",
+        "tpu_sidecar.py:_dispatch:jax.device_get",
+    }
+
+
+def test_jaxhazards_dispatch_loop_clean_when_sync_stays_in_boundary(
+        tmp_path):
+    findings = _lint(tmp_path, {
+        "fluidframework_tpu/service/tpu_sidecar.py": """
+            import numpy as np
+
+            class Sidecar:
+                def apply(self):
+                    self._settle()
+                    return self._dispatch()
+
+                def _dispatch(self):
+                    arrays = np.zeros((4, 4))
+                    self._settle()
+                    return arrays
+
+                def _settle(self):
+                    if np.asarray(self._table.overflow).any():
+                        self._recover()
+
+                def _recover(self):
+                    # reached only THROUGH the boundary: recovery may
+                    # sync freely
+                    return np.asarray(self._table.count)
+        """,
+        # an unregistered module with the same shape stays unscanned
+        "fluidframework_tpu/service/other.py": """
+            import numpy as np
+
+            class Other:
+                def _dispatch(self):
+                    return np.asarray([1])
+        """,
+    }, families=["jaxhazards"])
+    assert [f for f in findings if f.rule == "dispatch-loop-sync"] == []
+
+
 # ----------------------------------------------------------------- lockcheck
 
 LOCKED_COUNTER_BAD = """

@@ -43,6 +43,7 @@ def test_overgrown_doc_lands_in_pool_not_host():
     sidecar = make_pool_sidecar()
     c, s = write_doc(server, sidecar, "big", n_chunks=60)
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.pool_admit_count >= 1
     assert sidecar.pooled_docs() == 1
     assert sidecar.host_mode_docs() == 0, \
@@ -55,12 +56,14 @@ def test_pooled_doc_keeps_collaborating():
     sidecar = make_pool_sidecar()
     c, s = write_doc(server, sidecar, "big", n_chunks=60)
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.pooled_docs() == 1
     # continued edits dispatch through the seq-sharded window path
     for _ in range(10):
         s.insert_text(3, "XYZ")
         c.flush()
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.pooled_docs() == 1
     assert sidecar.host_mode_docs() == 0
     assert sidecar.text("big", "d", "s") == s.get_text()
@@ -72,6 +75,7 @@ def test_mixed_primary_and_pooled_docs_converge():
     big_c, big_s = write_doc(server, sidecar, "big", n_chunks=60)
     small_c, small_s = write_doc(server, sidecar, "small", n_chunks=4)
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.pooled_docs() == 1
     # both tiers keep taking edits in the same apply cycle
     big_s.insert_text(0, "B")
@@ -79,6 +83,7 @@ def test_mixed_primary_and_pooled_docs_converge():
     small_s.insert_text(0, "S")
     small_c.flush()
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.text("big", "d", "s") == big_s.get_text()
     assert sidecar.text("small", "d", "s") == small_s.get_text()
     assert sidecar.host_mode_docs() == 0
@@ -91,6 +96,7 @@ def test_beyond_pool_capacity_falls_back_to_host():
     sidecar = make_pool_sidecar(max_capacity=32, pool_capacity=64)
     c, s = write_doc(server, sidecar, "huge", n_chunks=120)
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.host_mode_docs() == 1
     assert sidecar.pooled_docs() == 0
     assert sidecar.text("huge", "d", "s") == s.get_text()
@@ -114,12 +120,14 @@ def test_pool_eviction_does_not_corrupt_remaining_members():
     a_c, a_s = write_doc(server, sidecar, "doc-a", n_chunks=60)
     b_c, b_s = write_doc(server, sidecar, "doc-b", n_chunks=60)
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.pooled_docs() == 2
     # grow doc-a past the pool capacity through the dispatch path
     for _ in range(120):
         a_s.insert_text(0, "zzzzzzzz")
         a_c.flush()
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.host_mode_docs() == 1       # doc-a evicted
     assert sidecar.pooled_docs() == 1          # doc-b survives
     # doc-b's reads stay correct, and further edits keep applying
@@ -127,6 +135,7 @@ def test_pool_eviction_does_not_corrupt_remaining_members():
     b_s.insert_text(0, "still-alive-")
     b_c.flush()
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.pooled_docs() == 1, "no spurious eviction"
     assert sidecar.text("doc-b", "d", "s") == b_s.get_text()
     assert sidecar.text("doc-a", "d", "s") == a_s.get_text()
@@ -141,12 +150,14 @@ def test_ingest_eviction_of_pooled_doc_rebuilds_pool():
     a_c, a_s = write_doc(server, sidecar, "doc-a", n_chunks=60)
     b_c, b_s = write_doc(server, sidecar, "doc-b", n_chunks=60)
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.pooled_docs() == 2
     # doc-a submits an op with more prop keys than PROP_CHANNELS:
     # encode fails -> ingest evicts doc-a mid-pool
     a_s.insert_text(0, "X", {f"k{i}": i for i in range(9)})
     a_c.flush()
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.host_mode_docs() == 1
     assert sidecar.pooled_docs() == 1
     assert sidecar.text("doc-a", "d", "s") == a_s.get_text()
@@ -155,6 +166,7 @@ def test_ingest_eviction_of_pooled_doc_rebuilds_pool():
     b_s.insert_text(0, "ok-")
     b_c.flush()
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.pooled_docs() == 1
     assert sidecar.text("doc-b", "d", "s") == b_s.get_text()
 
@@ -180,6 +192,117 @@ def test_remove_heavy_doc_fits_pool_after_compaction():
             s.remove_text(0, 4)
             c.flush()
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: pool policy runs at settle
     assert sidecar.host_mode_docs() == 0, \
         "compaction should keep the live set inside the pool"
     assert sidecar.text("churn", "d", "s") == s.get_text()
+
+
+def test_deferred_pool_ops_not_double_applied_by_recovery_rebuild():
+    """Pipelined settle ordering (review repro): round N defers window
+    ops for an already-pooled doc while ANOTHER doc overflows into the
+    pool in the same round. Recovery's admission rebuilds the pool
+    from the FULL canonical streams — which already contain the
+    deferred ops — so an incremental dispatch of the deferred batch
+    onto the rebuilt table applied those ops twice (served text
+    diverged). The stream watermarks make the rebuild subsume them."""
+    import jax
+
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.parallel import make_seq_mesh
+    from fluidframework_tpu.service import LocalServer, TpuMergeSidecar
+
+    mesh = make_seq_mesh(jax.devices()[:1])
+    server = LocalServer()
+    sidecar = TpuMergeSidecar(max_docs=3, capacity=16, max_capacity=16,
+                              seq_mesh=mesh, pool_capacity=256)
+    factory = LocalDocumentServiceFactory(server)
+
+    def open_doc(doc):
+        sidecar.subscribe(server, doc, "d", "s")
+        c = Container.load(factory.create_document_service(doc),
+                           client_id=f"{doc}-w")
+        s = c.runtime.create_datastore("d").create_channel(
+            "sharedstring", "s")
+        return c, s
+
+    big_c, big_s = open_doc("big")
+    other_c, other_s = open_doc("other")
+    # phase 1: "big" outgrows the ladder into the pool
+    for _ in range(20):
+        big_s.insert_text(0, "abcdefgh")
+        big_c.flush()
+    sidecar.apply()
+    sidecar.sync()
+    assert sidecar.pooled_docs() == 1
+
+    # phase 2, ONE apply: deferred traffic for the pooled doc plus a
+    # second doc overflowing into the pool (recovery rebuild) in the
+    # same settle
+    for _ in range(20):
+        big_s.insert_text(0, "abcdefgh")
+    big_c.flush()
+    for _ in range(20):
+        other_s.insert_text(0, "qrstuvwx")
+    other_c.flush()
+    sidecar.apply()
+    sidecar.sync()
+    assert sidecar.pooled_docs() == 2
+    assert sidecar.text("big", "d", "s") == big_s.get_text()
+    assert sidecar.text("other", "d", "s") == other_s.get_text()
+
+
+def test_pool_ops_packed_across_recovery_rebuild_apply_once():
+    """Second interleaving of the same bug: doc 'other' overflows in
+    round N with the flag UNSETTLED (pipelined default); round N+1
+    packs new ops for the already-pooled 'big', and its LEADING settle
+    recovers round N (pool rebuild from full streams, subsuming big's
+    just-packed ops). Pre-watermark code then queued those ops for the
+    next pool dispatch anyway — applied twice."""
+    import jax
+
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.parallel import make_seq_mesh
+    from fluidframework_tpu.service import LocalServer, TpuMergeSidecar
+
+    mesh = make_seq_mesh(jax.devices()[:1])
+    server = LocalServer()
+    sidecar = TpuMergeSidecar(max_docs=3, capacity=16, max_capacity=16,
+                              seq_mesh=mesh, pool_capacity=256)
+    factory = LocalDocumentServiceFactory(server)
+
+    def open_doc(doc):
+        sidecar.subscribe(server, doc, "d", "s")
+        c = Container.load(factory.create_document_service(doc),
+                           client_id=f"{doc}-w")
+        s = c.runtime.create_datastore("d").create_channel(
+            "sharedstring", "s")
+        return c, s
+
+    big_c, big_s = open_doc("big")
+    other_c, other_s = open_doc("other")
+    for _ in range(20):
+        big_s.insert_text(0, "abcdefgh")
+        big_c.flush()
+    sidecar.apply()
+    sidecar.sync()
+    assert sidecar.pooled_docs() == 1
+
+    # round N: 'other' overflows — do NOT settle (pipelined)
+    for _ in range(20):
+        other_s.insert_text(0, "qrstuvwx")
+    other_c.flush()
+    sidecar.apply()
+
+    # round N+1: new ops for pooled 'big'; the leading settle of this
+    # apply runs round N's recovery (pool rebuild) mid-flight
+    for _ in range(3):
+        big_s.insert_text(0, "XY")
+    big_c.flush()
+    sidecar.apply()
+    sidecar.sync()
+    assert sidecar.pooled_docs() == 2
+    assert sidecar.text("big", "d", "s") == big_s.get_text()
+    assert sidecar.text("other", "d", "s") == other_s.get_text()

@@ -52,6 +52,7 @@ def test_overflow_grows_capacity_ladder():
     sidecar = TpuMergeSidecar(max_docs=2, capacity=16, max_capacity=512)
     c, s = _session(server, sidecar, "doc")
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: recovery runs at settle
     assert sidecar.grow_count >= 1, "expected slab growth"
     assert sidecar.host_mode_docs() == 0
     assert not sidecar.overflowed()
@@ -63,6 +64,7 @@ def test_overflow_evicts_to_host_at_max_capacity():
     sidecar = TpuMergeSidecar(max_docs=2, capacity=16, max_capacity=16)
     c, s = _session(server, sidecar, "doc")
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: recovery runs at settle
     assert sidecar.evict_count >= 1
     assert sidecar.host_mode_docs() == 1
     assert not sidecar.overflowed()
@@ -108,6 +110,7 @@ def test_healthy_docs_unaffected_by_neighbor_eviction():
     s2.insert_text(0, "tiny")
     c2.flush()
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: recovery runs at settle
     assert sidecar.host_mode_docs() == 1
     assert sidecar.text("big", "d", "s") == s1.get_text()
     assert sidecar.text("small", "d", "s") == s2.get_text()
@@ -170,6 +173,7 @@ def test_post_eviction_new_prop_value_signature():
     sidecar = TpuMergeSidecar(max_docs=2, capacity=16, max_capacity=16)
     c, s = _session(server, sidecar, "doc")
     sidecar.apply()
+    sidecar.sync()  # pipelined dispatch: recovery runs at settle
     assert sidecar.host_mode_docs() == 1
     s.annotate_range(0, 4, {"bold": 777})  # value the encoder never saw
     c.flush()

@@ -15,6 +15,7 @@ import time
 import pytest
 
 from fluidframework_tpu.drivers.socket_driver import (
+    WIRE_VERSIONS,
     SocketDocumentService,
 )
 from fluidframework_tpu.loader import Container
@@ -137,7 +138,7 @@ def test_mixed_version_clients_collaborate(alfred):
     svc_new, c_new = _load(server.port, "mix", "new")
     try:
         assert svc_old.agreed_version == "1.0"
-        assert svc_new.agreed_version == "1.1"
+        assert svc_new.agreed_version == WIRE_VERSIONS[0]
         with svc_old.lock:
             t_old = c_old.runtime.create_datastore(
                 "ds").create_channel("sharedstring", "t")
