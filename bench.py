@@ -1640,6 +1640,17 @@ STAGE_FNS = {
 }
 
 
+def _registry_snapshot() -> dict | None:
+    """The obs metrics registry, or None if obs failed to import (a
+    broken registry must not lose a measured stage)."""
+    try:
+        from fluidframework_tpu.obs import metrics as _obs_metrics
+
+        return _obs_metrics.REGISTRY.snapshot()
+    except Exception:  # noqa: BLE001 - snapshot is best-effort
+        return None
+
+
 def run_stage(name: str, backend: str, scale: str, reps: int,
               cooldown: float, out_path: str | None) -> None:
     _stage_env_setup(backend)
@@ -1652,6 +1663,11 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         "scale": scale,
         "corpus": STAGE_CORPUS.get(name),
         "stage_elapsed_s": round(time.perf_counter() - t0, 1),
+        # the unified metrics registry's view of everything this
+        # stage's process did (sidecar rounds, sequencer tickets,
+        # pack/settle histograms...) — per-stage attribution comes
+        # free because each stage runs in its own subprocess
+        "metrics_registry": _registry_snapshot(),
     })
     # persist the full-scale result BEFORE the fixed-scale companion:
     # if the companion pushes the child past the subprocess timeout,
@@ -1672,6 +1688,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         fixed = STAGE_FNS[name]("cpu", max(1, reps // 2), 0.5)
         fixed["corpus"] = STAGE_CORPUS.get(name)
         fixed["stage_elapsed_s"] = round(time.perf_counter() - t1, 1)
+        fixed["metrics_registry"] = _registry_snapshot()
         result["fixed_scale"] = fixed
         with open(out_path, "w") as f:
             json.dump(result, f)

@@ -73,6 +73,25 @@ DISPATCH_LOOPS = {
          "_apply_program"),
         ("_settle", "sync"),
     ),
+    # The obs instrumentation the dispatch loop calls into (flight-
+    # recorder records, metric bumps, trace stamps) must itself stay
+    # sync-free: host timestamps and pre-fetched scalars only. Rooting
+    # the rule at these entry points extends dispatch-loop-sync over
+    # the new obs call sites — a device read sneaking into record()/
+    # inc()/observe()/stamp() would silently re-serialize every
+    # instrumented loop in the repo.
+    "obs/flight_recorder.py": (
+        ("record", "dump", "dump_to", "events"),
+        (),
+    ),
+    "obs/metrics.py": (
+        ("inc", "dec", "set", "observe", "labels"),
+        (),
+    ),
+    "obs/trace.py": (
+        ("stamp",),
+        (),
+    ),
 }
 
 

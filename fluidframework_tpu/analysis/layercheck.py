@@ -32,23 +32,28 @@ ALLOWED = {
     "analysis": set(),  # the linter depends on nothing it lints
     "utils": set(),
     "protocol": {"utils"},
+    # obs sits just above protocol: every layer may observe (trace
+    # stamps, metrics, flight recorders), and obs itself depends only
+    # on the wire Trace type + utils — never on what it observes
+    "obs": {"protocol", "utils"},
     "models": {"protocol", "utils", "runtime"},  # runtime: the
     # SharedObject contract lives in runtime/shared_object (layer 6
     # sits on the datastore runtime, sharedObject.ts:42)
     "ops": {"models", "protocol", "utils"},
-    "runtime": {"protocol", "utils"},
-    "drivers": {"protocol", "service", "utils"},  # local/socket
+    "runtime": {"obs", "protocol", "utils"},
+    "drivers": {"obs", "protocol", "service", "utils"},  # local/socket
     # drivers bind to the in-proc/networked service (local-driver ->
     # local-server in the reference)
-    "loader": {"drivers", "models", "protocol", "runtime", "utils"},
+    "loader": {"drivers", "models", "obs", "protocol", "runtime",
+               "utils"},
     "framework": {"drivers", "loader", "models", "runtime",
                   "service", "utils"},
-    "service": {"models", "native", "ops", "protocol", "utils"},
+    "service": {"models", "native", "obs", "ops", "protocol", "utils"},
     "native": {"ops", "protocol", "service", "utils"},
     "parallel": {"ops", "utils"},
-    "testing": {"models", "ops", "protocol", "runtime", "service",
-                "utils"},
-    "tools": {"drivers", "loader", "models", "ops", "protocol",
+    "testing": {"models", "obs", "ops", "protocol", "runtime",
+                "service", "utils"},
+    "tools": {"drivers", "loader", "models", "obs", "ops", "protocol",
               "runtime", "service", "testing", "utils"},
 }
 

@@ -1,0 +1,151 @@
+"""obscheck — observability-contract rules.
+
+``obs-untimed-hop``: every trace hop a module registers must come
+from the canonical hop table in ``fluidframework_tpu/obs/trace.py``
+(``CANONICAL_HOPS``). An unregistered hop name fragments the
+vocabulary that per-op breakdowns, dashboards and the docs group on —
+and would silently dodge the runtime ``ValueError`` only where the
+stamp call is built dynamically. Checked statically at every
+``stamp(...)`` call and every direct ``Trace(service, action)``
+construction whose service/action are string literals; dynamic
+arguments are left to the runtime check.
+
+The canonical table is read from the obs source with
+``ast.literal_eval`` — the linter keeps its "depends on nothing it
+lints" property (no runtime import of the package under analysis),
+and the table is required to stay a pure literal for exactly this
+reason.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from .core import Finding, PKG_ROOT, SourceFile
+
+_TRACE_PATH = os.path.join(PKG_ROOT, "obs", "trace.py")
+
+# call targets that register a hop: obs.trace.stamp (any import
+# spelling) and the protocol Trace dataclass constructed directly
+_STAMP_SUFFIXES = ("obs.trace.stamp", "obs.stamp")
+_TRACE_SUFFIXES = ("protocol.messages.Trace", "messages.Trace",
+                   "protocol.Trace")
+
+
+def load_canonical_hops(path: str = _TRACE_PATH) -> set[tuple]:
+    """Extract CANONICAL_HOPS from the obs source as data."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "CANONICAL_HOPS"
+            for t in node.targets
+        ):
+            table = ast.literal_eval(node.value)
+            return set(table)
+    raise ValueError(
+        f"CANONICAL_HOPS literal not found in {path}; the obs hop "
+        "table must stay a pure literal (obscheck reads it statically)"
+    )
+
+
+def _import_aliases(tree: ast.AST) -> dict[str, str]:
+    """local name -> dotted path (module-level and local imports)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            # relative imports keep the module tail (``..obs.trace``
+            # -> ``obs.trace``): suffix matching below doesn't need
+            # the absolute package prefix
+            for a in node.names:
+                aliases[a.asname or a.name] = (
+                    f"{node.module}.{a.name}"
+                )
+    return aliases
+
+
+def _dotted(node: ast.AST, aliases: dict[str, str]) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+def _matches_suffix(dotted: str, suffixes: tuple[str, ...]) -> bool:
+    # the resolved path must END in a known suffix (exact for the
+    # relative-import spelling, dotted-prefix for the absolute one).
+    # Deliberately NOT the reverse: a module's own unrelated function
+    # that happens to be named ``stamp`` (or class named ``Trace``)
+    # resolves to a bare name with no import alias and must not
+    # false-positive the tier-1 gate — real obs/protocol usage always
+    # arrives through an import, which gives the dotted path.
+    return any(
+        dotted == s or dotted.endswith("." + s) for s in suffixes
+    )
+
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    hops = load_canonical_hops()
+    findings: list[Finding] = []
+    for src in files:
+        if src.tree is None:
+            continue
+        if src.relpath.endswith("obs/trace.py"):
+            continue  # the table's own module
+        aliases = _import_aliases(src.tree)
+        module = src.relpath.rsplit("/", 1)[-1]
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func, aliases)
+            if dotted is None:
+                continue
+            if _matches_suffix(dotted, _STAMP_SUFFIXES):
+                # stamp(traces, service, action, ...)
+                args = node.args[1:3]
+            elif _matches_suffix(dotted, _TRACE_SUFFIXES):
+                # Trace(service, action, ...) — keyword form included
+                args = list(node.args[:2])
+                kw = {k.arg: k.value for k in node.keywords}
+                while len(args) < 2:
+                    name = ("service", "action")[len(args)]
+                    if name not in kw:
+                        break
+                    args.append(kw[name])
+            else:
+                continue
+            if len(args) < 2:
+                continue
+            service = _literal_str(args[0])
+            action = _literal_str(args[1])
+            if service is None or action is None:
+                continue  # dynamic: the runtime ValueError covers it
+            if (service, action) not in hops:
+                findings.append(Finding(
+                    rule="obs-untimed-hop",
+                    path=src.relpath, line=node.lineno,
+                    message=(
+                        f"trace hop {service}:{action} is not in the "
+                        "canonical hop table (fluidframework_tpu/obs/"
+                        "trace.py CANONICAL_HOPS) — register it there "
+                        "so breakdowns and dashboards can group on it"
+                    ),
+                    key=f"{module}:{service}:{action}",
+                ))
+    return findings

@@ -7,8 +7,35 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-from ..protocol.messages import Nack, SequencedMessage
+from ..obs.trace import stamp as trace_stamp
+from ..protocol.messages import DocumentMessage, Nack, SequencedMessage
 from ..service.local_server import DeltaConnection, LocalServer
+
+
+class _TracingDeltaConnection:
+    """Stamps the ``driver:send`` hop on outbound ops, so in-proc
+    traces line up with the socket driver's (no ``driver:deliver``
+    stamp in-proc: the broadcast message OBJECT is shared by every
+    subscriber, and per-client delivery stamps on a shared list would
+    pollute each other's view)."""
+
+    def __init__(self, inner: DeltaConnection):
+        self._inner = inner
+
+    def submit(self, op: DocumentMessage) -> None:
+        trace_stamp(op.traces, "driver", "send")
+        self._inner.submit(op)
+
+    def disconnect(self) -> None:
+        self._inner.disconnect()
+
+    @property
+    def open(self) -> bool:
+        return self._inner.open
+
+    @property
+    def client_id(self) -> str:
+        return self._inner.client_id
 
 
 class LocalDocumentService:
@@ -21,10 +48,10 @@ class LocalDocumentService:
         client_id: str,
         on_message: Callable[[SequencedMessage], None],
         on_nack: Optional[Callable[[Nack], None]] = None,
-    ) -> DeltaConnection:
-        return self._server.connect(
+    ) -> _TracingDeltaConnection:
+        return _TracingDeltaConnection(self._server.connect(
             self.document_id, client_id, on_message, on_nack
-        )
+        ))
 
     def read_ops(self, from_seq: int, to_seq: Optional[int] = None
                  ) -> list[SequencedMessage]:

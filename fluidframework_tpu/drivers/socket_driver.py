@@ -36,12 +36,26 @@ import sys
 import threading
 from typing import Callable, Optional
 
+from ..obs import metrics as obs_metrics
+from ..obs.flight_recorder import FlightRecorder
+from ..obs.trace import stamp as trace_stamp
 from ..protocol.messages import DocumentMessage, Nack, NackErrorType, SequencedMessage
 from ..protocol.constants import wire_version_lt
 from ..protocol.serialization import decode_contents, message_from_json
 from ..service.ingress import document_message_to_json, pack_frame
 
 _LEN = struct.Struct(">I")
+
+_FRAMES_SENT = obs_metrics.REGISTRY.counter(
+    "driver_frames_sent_total", "frames the socket driver sent")
+_FRAMES_RECV = obs_metrics.REGISTRY.counter(
+    "driver_frames_received_total", "frames the socket driver parsed")
+_DISPATCH_FAULTS = obs_metrics.REGISTRY.counter(
+    "driver_dispatch_faults_total",
+    "delivery callbacks that raised (transport torn down loudly)")
+_REQUEST_TIMEOUTS = obs_metrics.REGISTRY.counter(
+    "driver_request_timeouts_total",
+    "request/response deadlines missed (flight dump emitted)")
 
 
 # wire versions this driver speaks, newest first (the server echoes
@@ -104,6 +118,12 @@ class SocketDocumentService:
         self._connected = threading.Event()
         self._closed = False
         self.last_error: Optional[str] = None
+        # transport flight recorder: the last N frames in/out, dumped
+        # automatically on a dispatch fault or a missed deadline (the
+        # postmortem the PR-2 ack stall lacked)
+        self.flight = FlightRecorder(
+            128, name=f"socket-{document_id}")
+        self.last_flight_dump: Optional[str] = None
         self._inbox: queue.Queue[Optional[dict]] = queue.Queue()
         self._pump = threading.Thread(
             target=self._recv_loop, daemon=True,
@@ -120,6 +140,9 @@ class SocketDocumentService:
 
     def _send(self, data: dict) -> None:
         frame = pack_frame(data)
+        self.flight.record("send", type=data.get("type"),
+                           rid=data.get("rid"), bytes=len(frame))
+        _FRAMES_SENT.inc()
         with self._send_lock:
             self._sock.sendall(frame)
 
@@ -146,6 +169,12 @@ class SocketDocumentService:
                 if body is None:
                     break
                 frame = json.loads(body.decode("utf-8"))
+                self.flight.record(
+                    "recv", type=frame.get("type"),
+                    rid=frame.get("rid"),
+                    seq=(frame.get("msg") or {}).get("sequenceNumber"),
+                )
+                _FRAMES_RECV.inc()
                 rid = frame.get("rid")
                 if rid is not None:
                     with self._pending_lock:
@@ -171,6 +200,7 @@ class SocketDocumentService:
         finally:
             # even on a parse error the shutdown protocol must run, or
             # the dispatcher and every pending request hang
+            self.flight.record("transport-closed")
             self._closed = True
             self._inbox.put(None)
             with self._pending_lock:
@@ -215,12 +245,19 @@ class SocketDocumentService:
                     f"dispatch fault on {frame.get('type')!r}: "
                     f"{traceback.format_exc()}"
                 )
+                _DISPATCH_FAULTS.inc()
+                self.flight.record("dispatch-fault",
+                                   type=frame.get("type"))
                 with self.lock:
                     self.last_error = err
                 print(
                     f"socket-driver[{self.document_id}]: {err}",
                     file=sys.stderr,
                 )
+                # postmortem: the last N transport events that led
+                # here (what was delivered, what was in flight)
+                self.last_flight_dump = self.flight.dump_to(
+                    reason="dispatch fault teardown")
                 self.close()
                 break
 
@@ -250,7 +287,11 @@ class SocketDocumentService:
             )
             return
         if kind == "op" and self._on_message is not None:
-            self._on_message(message_from_json(frame["msg"]))
+            msg = message_from_json(frame["msg"])
+            # per-session deserialized copy: the deliver hop is this
+            # client's own (unlike the shared in-proc object)
+            trace_stamp(msg.traces, "driver", "deliver")
+            self._on_message(msg)
         elif kind == "nack" and self._on_nack is not None:
             from ..service.ingress import document_message_from_json
 
@@ -274,7 +315,20 @@ class SocketDocumentService:
         if not event.wait(self._timeout):
             with self._pending_lock:
                 self._pending.pop(rid, None)
-            raise TimeoutError(f"no response to {data['type']}")
+            # a missed deadline used to be a bare TimeoutError with
+            # zero context; dump the recent transport events so the
+            # postmortem ships with the exception
+            _REQUEST_TIMEOUTS.inc()
+            self.flight.record("request-timeout", type=data["type"],
+                               rid=rid)
+            self.last_flight_dump = self.flight.dump_to(
+                reason=f"no response to {data['type']} "
+                       f"(rid={rid}) within {self._timeout}s")
+            raise TimeoutError(
+                f"no response to {data['type']} (rid={rid}) within "
+                f"{self._timeout}s; recent transport events:\n"
+                f"{self.last_flight_dump}"
+            )
         if not slot:
             raise ConnectionError("connection closed mid-request")
         frame = slot[0]
@@ -308,7 +362,11 @@ class SocketDocumentService:
             self.tenant_id, self.token,
             versions=self.wire_versions))
         if not self._connected.wait(self._timeout):
-            raise TimeoutError("connect_document handshake timed out")
+            self.last_flight_dump = self.flight.dump_to(
+                reason="connect_document handshake deadline missed")
+            raise TimeoutError(
+                "connect_document handshake timed out; recent "
+                f"transport events:\n{self.last_flight_dump}")
         if self.auth_error is not None:
             raise PermissionError(
                 f"connect_document rejected: {self.auth_error}")
@@ -458,6 +516,10 @@ class SocketDeltaConnection:
         assert self.open, "submit on closed connection"
         from ..protocol.constants import batch_flag
 
+        # stamped BEFORE serialization so the hop rides the wire (the
+        # boxcar frame carries each member op's traces — wire 1.2 —
+        # and the per-op fallback frame carries them identically)
+        trace_stamp(op.traces, "driver", "send")
         wire = document_message_to_json(op)
         flag = batch_flag(op.metadata)
         if self._boxcar_capable() and (self._batching or flag is True):

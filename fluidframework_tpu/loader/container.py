@@ -20,6 +20,9 @@ from typing import Any, Optional
 
 from ..drivers.definitions import DocumentService
 from ..models import default_registry
+from ..obs import metrics as obs_metrics
+from ..obs import register_closeable
+from ..obs.trace import stamp as trace_stamp
 from ..protocol.messages import (
     ClientDetail,
     DocumentMessage,
@@ -32,6 +35,17 @@ from ..runtime import ChannelRegistry, ContainerRuntime
 from ..utils.events import EventEmitter
 from .collab_window import CollabWindowTracker
 from .scheduler import DeltaScheduler, ScheduleManager
+
+_OPS_SUBMITTED = obs_metrics.REGISTRY.counter(
+    "container_ops_submitted_total",
+    "runtime ops this process's containers put on the wire")
+_OPS_ACKED = obs_metrics.REGISTRY.counter(
+    "container_ops_acked_total",
+    "own ops seen back sequenced (submit→ack completed)")
+_NACKS_SEEN = obs_metrics.REGISTRY.counter(
+    "container_nacks_total", "nacks containers received")
+_ROUNDTRIP_MS = obs_metrics.REGISTRY.histogram(
+    "container_op_roundtrip_ms", "submit→ack wall latency per own op")
 
 
 class Container(EventEmitter):
@@ -50,10 +64,18 @@ class Container(EventEmitter):
         # telemetry/config travel together (mixinMonitoringContext)
         self.mc = mc or MonitoringContext(TelemetryLogger())
         self._sent_times: dict[int, float] = {}
-        # op-roundtrip latency, sampled (connectionTelemetry.ts:288)
+        # op-roundtrip latency, sampled (connectionTelemetry.ts:288);
+        # registered with the obs shutdown path so tail measurements
+        # flush even when close() is never reached
         self._op_latency = SampledTelemetryHelper(
             self.mc.logger, "opRoundtripTime", sample_every=20,
         )
+        register_closeable(self._op_latency)
+        # per-op submit→ack trace attribution (obs pillar 1): the
+        # newest acked ops' full hop breakdowns, via op_breakdown()
+        from ..runtime.op_lifecycle import OpLatencyLedger
+
+        self.op_ledger = OpLatencyLedger()
         self.runtime = ContainerRuntime(registry or default_registry())
         self.runtime.set_submit_fn(self._submit_runtime_op)
         self.protocol = ProtocolOpHandler()
@@ -257,7 +279,25 @@ class Container(EventEmitter):
 
     def close(self) -> None:
         self.disconnect()
+        # flush the sampled-latency tail (measurements below
+        # sample_every used to vanish at teardown)
+        self._op_latency.close()
         self.closed = True
+
+    # ------------------------------------------------------------------
+    # per-op latency attribution (obs pillar 1)
+
+    def op_trace(self, csn: Optional[int] = None) -> Optional[dict]:
+        """The ledgered trace entry for one of this container's own
+        acked ops (by clientSequenceNumber; newest when omitted):
+        {clientSequenceNumber, sequenceNumber, traces, hops,
+        total_ms}."""
+        return self.op_ledger.get(csn)
+
+    def op_breakdown(self, csn: Optional[int] = None) -> str:
+        """Formatted ordered hop list with per-hop latencies — the
+        "where did op X spend its time" view."""
+        return self.op_ledger.format(csn)
 
     # ------------------------------------------------------------------
     # inbound (DeltaManager inbound queue + gap refetch)
@@ -316,8 +356,22 @@ class Container(EventEmitter):
                     msg.client_sequence_number, None
                 )
                 if sent is not None:
-                    self._op_latency.record(
-                        (time.monotonic() - sent) * 1000
+                    roundtrip_ms = (time.monotonic() - sent) * 1000
+                    self._op_latency.record(roundtrip_ms)
+                    _ROUNDTRIP_MS.observe(roundtrip_ms)
+                    _OPS_ACKED.inc()
+                    # the terminal hop: our own IN-FLIGHT op came back
+                    # sequenced — close the trace and ledger the full
+                    # breakdown. Guarded by `sent` on purpose: replays
+                    # (reload catch-up, reconnect) revisit ops this
+                    # instance never submitted, and on the in-proc
+                    # path the message OBJECT is the durable op-log
+                    # entry — an unguarded stamp would append a bogus
+                    # ack hop to shared history on every reload
+                    trace_stamp(msg.traces, "client", "ack")
+                    self.op_ledger.record(
+                        msg.client_sequence_number,
+                        msg.sequence_number, msg.traces,
                     )
             self.runtime.process(msg)
         else:
@@ -365,6 +419,7 @@ class Container(EventEmitter):
         (connectionManager.ts nack handling); we tear the connection
         down immediately (safe mid-submit: later submits of the same
         flush stay pending) and reconnect at the next flush."""
+        _NACKS_SEEN.inc()
         self.emit("nack", nack)
         self.mc.logger.send_error_event(
             "nack", clientId=self.client_id, reason=nack.message,
@@ -381,12 +436,17 @@ class Container(EventEmitter):
         self._csn += 1
         self._sent_times[self._csn] = time.monotonic()
         self.collab_window.on_op_sent(self.last_processed_seq)
+        _OPS_SUBMITTED.inc()
         self._connection.submit(DocumentMessage(
             client_sequence_number=self._csn,
             reference_sequence_number=self.last_processed_seq,
             type=MessageType.OPERATION,
             contents=contents,
             metadata=metadata,
+            # trace origin: doc/client identity travels implicitly
+            # (client_id on the sequenced form, csn here); the stamp
+            # chain starts at the outbox
+            traces=trace_stamp([], "client", "submit"),
         ))
 
     def _submit_noop(self) -> None:

@@ -1,0 +1,62 @@
+"""Cross-layer observability: op tracing, the unified metrics
+registry, and the flight recorder.
+
+Three pillars (docs/OBSERVABILITY.md):
+
+- ``obs.trace`` — the canonical hop table + :func:`stamp`; every
+  layer stamps an op's ``traces`` list through it, so a single op's
+  submit→ack path is reconstructable (``breakdown`` /
+  ``format_breakdown``).
+- ``obs.metrics`` — ONE process-wide :data:`REGISTRY` of counters /
+  gauges / histograms with Prometheus text exposition and a JSON
+  snapshot; ingress serves it over the ``metrics`` frame, bench
+  snapshots it into every stage record.
+- ``obs.flight_recorder`` — fixed-size lock-free ring of recent
+  dispatch-loop / transport events, dumped automatically on faults.
+
+This package sits just above ``protocol`` in the layer map so every
+other layer may depend on it; it depends on nothing above.
+"""
+from __future__ import annotations
+
+import weakref
+
+from .flight_recorder import FlightRecorder
+from .metrics import REGISTRY, MetricsRegistry, get_registry
+from .trace import (
+    CANONICAL_HOPS,
+    breakdown,
+    format_breakdown,
+    hop_name,
+    stamp,
+    total_ms,
+)
+
+__all__ = [
+    "CANONICAL_HOPS", "FlightRecorder", "MetricsRegistry", "REGISTRY",
+    "breakdown", "format_breakdown", "get_registry", "hop_name",
+    "register_closeable", "shutdown", "stamp", "total_ms",
+]
+
+# ----------------------------------------------------------------------
+# shutdown path: telemetry aggregators (SampledTelemetryHelper and
+# friends) register here so their TAIL measurements flush at teardown
+# instead of being silently dropped — weakrefs, so registration never
+# extends an owner's lifetime.
+
+_closeables: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_closeable(obj) -> None:
+    """Register an object with a ``close()`` method to be closed (and
+    thereby flushed) by :func:`shutdown`."""
+    _closeables.add(obj)
+
+
+def shutdown() -> None:
+    """Close every registered aggregator (idempotent; close() on these
+    is required to be re-entrant safe)."""
+    for obj in list(_closeables):
+        obj.close()
+    # closed objects may be re-registered by a later session; keep the
+    # set — close() is idempotent on all registrants by contract

@@ -94,7 +94,7 @@ def decode_contents(value: Any) -> Any:
 
 
 def message_to_json(msg: SequencedMessage) -> dict:
-    return {
+    out = {
         "clientId": msg.client_id,
         "sequenceNumber": msg.sequence_number,
         "minimumSequenceNumber": msg.minimum_sequence_number,
@@ -105,9 +105,17 @@ def message_to_json(msg: SequencedMessage) -> dict:
         "metadata": encode_contents(msg.metadata),
         "timestamp": msg.timestamp,
     }
+    # traces are OPTIONAL on the wire (protocol.ts ITrace is too): an
+    # untraced message serializes byte-identically to the pre-tracing
+    # format, so recorded corpora and 1.0/1.1 peers are unaffected
+    if msg.traces:
+        out["traces"] = [dataclasses.asdict(t) for t in msg.traces]
+    return out
 
 
 def message_from_json(data: dict) -> SequencedMessage:
+    from .messages import Trace
+
     return SequencedMessage(
         client_id=data["clientId"],
         sequence_number=data["sequenceNumber"],
@@ -118,6 +126,7 @@ def message_from_json(data: dict) -> SequencedMessage:
         contents=decode_contents(data["contents"]),
         metadata=decode_contents(data.get("metadata")),
         timestamp=data.get("timestamp", 0.0),
+        traces=[Trace(**t) for t in data.get("traces", [])],
     )
 
 

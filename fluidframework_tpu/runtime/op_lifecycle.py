@@ -156,6 +156,77 @@ def stage_outbound(envelope: dict, compressor: OpCompressor,
     return splitter.split_encoded(envelope, payload)
 
 
+class OpLatencyLedger:
+    """Bounded per-op submit→ack latency attribution.
+
+    The container feeds it when one of its OWN ops comes back
+    sequenced: the op's full trace (submit, driver-send, ingress,
+    sequencer ticket, fanout, deliver, ack — whatever hops the path
+    stamped) is kept per clientSequenceNumber, newest ``capacity``
+    entries retained. This is the per-op half of observability; the
+    metrics registry keeps the aggregates."""
+
+    def __init__(self, capacity: int = 128):
+        self.capacity = capacity
+        # csn -> entry; insertion-ordered so eviction drops the oldest
+        self._entries: dict[int, dict] = {}
+
+    def record(self, csn: int, sequence_number: int,
+               traces: list) -> dict:
+        from ..obs.trace import breakdown, total_ms
+
+        entry = {
+            "clientSequenceNumber": csn,
+            "sequenceNumber": sequence_number,
+            "traces": list(traces),
+            "hops": breakdown(traces),
+            "total_ms": total_ms(traces),
+        }
+        self._entries[csn] = entry
+        while len(self._entries) > self.capacity:
+            self._entries.pop(next(iter(self._entries)))
+        return entry
+
+    def get(self, csn: Optional[int] = None) -> Optional[dict]:
+        """The entry for ``csn``, or the newest one when omitted."""
+        if csn is not None:
+            return self._entries.get(csn)
+        if not self._entries:
+            return None
+        return self._entries[next(reversed(self._entries))]
+
+    def format(self, csn: Optional[int] = None) -> str:
+        from ..obs.trace import format_breakdown
+
+        entry = self.get(csn)
+        if entry is None:
+            return "(no acked op recorded)"
+        return (
+            f"op csn={entry['clientSequenceNumber']} "
+            f"seq={entry['sequenceNumber']} "
+            f"({entry['total_ms']:.3f} ms submit→ack)\n"
+            + format_breakdown(entry["traces"])
+        )
+
+    def summary(self) -> dict:
+        """Per-hop mean/max delta over the retained entries."""
+        agg: dict[str, list[float]] = {}
+        for entry in self._entries.values():
+            for hop in entry["hops"]:
+                agg.setdefault(hop["hop"], []).append(hop["delta_ms"])
+        return {
+            hop: {
+                "count": len(ds),
+                "mean_ms": sum(ds) / len(ds),
+                "max_ms": max(ds),
+            }
+            for hop, ds in agg.items()
+        }
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
 # batch boundary marks moved to the protocol layer (they are a wire
 # contract the drivers also consume); re-exported here for the
 # runtime-side users

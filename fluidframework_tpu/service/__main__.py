@@ -8,12 +8,41 @@ service for development). Usage:
 Clients connect with
 ``drivers.socket_driver.SocketDocumentServiceFactory`` and the normal
 ``loader.Container`` on top.
+
+Observability: a running service answers the ``metrics`` frame with
+the process-wide registry (fluidframework_tpu/obs/metrics.py);
+
+    python -m fluidframework_tpu.service --dump-metrics HOST:PORT
+
+is the /metrics-equivalent dump command (Prometheus text exposition;
+``--json`` for the structured snapshot).
 """
 from __future__ import annotations
 
 import argparse
 
 from .ingress import run_server
+
+
+def dump_metrics(target: str, as_json: bool) -> int:
+    """Connect to a running service and print its metrics registry."""
+    import json
+    import socket
+
+    from .ingress import _parse_hostport, pack_frame, recv_frame_blocking
+
+    host, port = _parse_hostport(target)
+    with socket.create_connection((host, port), timeout=10.0) as sock:
+        sock.sendall(pack_frame({"type": "metrics", "rid": 1}))
+        frame = recv_frame_blocking(sock)
+    if frame.get("type") != "metrics":
+        print(f"unexpected response: {frame}")
+        return 1
+    if as_json:
+        print(json.dumps(frame["metrics"], indent=2, sort_keys=True))
+    else:
+        print(frame["text"], end="")
+    return 0
 
 
 def main() -> None:
@@ -35,7 +64,17 @@ def main() -> None:
                              "fluidframework_tpu.service.broker — the "
                              "networked ordering queue (partitions "
                              "span hosts)")
+    parser.add_argument("--dump-metrics", default=None,
+                        metavar="HOST:PORT",
+                        help="print a RUNNING service's metrics "
+                             "registry (Prometheus text) and exit "
+                             "instead of serving")
+    parser.add_argument("--json", action="store_true",
+                        help="with --dump-metrics: emit the JSON "
+                             "snapshot instead of text exposition")
     args = parser.parse_args()
+    if args.dump_metrics is not None:
+        raise SystemExit(dump_metrics(args.dump_metrics, args.json))
     run_server(args.host, args.port, args.data_dir, args.partitions,
                args.broker)
 

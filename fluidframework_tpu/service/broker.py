@@ -34,6 +34,7 @@ import socket
 import threading
 from typing import Iterator, Optional
 
+from ..obs import metrics as obs_metrics
 from .ingress import pack_frame, read_frame, recv_frame_blocking
 from .partitioning import (
     FileOrderingQueue,
@@ -41,6 +42,15 @@ from .partitioning import (
     OrderingQueue,
     QueueRecord,
 )
+
+_PRODUCED = obs_metrics.REGISTRY.counter(
+    "broker_records_produced_total", "records appended to partitions")
+_READ = obs_metrics.REGISTRY.counter(
+    "broker_records_read_total", "records served to consumers")
+_COMMITS = obs_metrics.REGISTRY.counter(
+    "broker_commits_total", "consumer offset commits")
+_BROKER_ERRORS = obs_metrics.REGISTRY.counter(
+    "broker_frame_errors_total", "broker frames that raised")
 
 
 class BrokerServer:
@@ -96,6 +106,7 @@ class BrokerServer:
                 try:
                     resp = self._dispatch(frame)
                 except Exception as e:  # noqa: BLE001 - report per frame
+                    _BROKER_ERRORS.inc()
                     resp = {
                         "type": "error",
                         "message": f"{type(e).__name__}: {e}",
@@ -121,6 +132,7 @@ class BrokerServer:
             offset = self.queue.produce(
                 p, frame["document_id"], frame["payload"]
             )
+            _PRODUCED.inc()
             return {"type": "produced", "offset": offset}
         if kind == "read":
             limit = int(frame.get("max", 500))
@@ -133,12 +145,14 @@ class BrokerServer:
                 })
                 if len(out) >= limit:
                     break
+            _READ.inc(len(out))
             return {"type": "records", "records": out}
         if kind == "committed":
             return {"type": "committed_offset",
                     "offset": self.queue.committed(p)}
         if kind == "commit":
             self.queue.commit(p, int(frame["offset"]))
+            _COMMITS.inc()
             return {"type": "commit_ack"}
         if kind == "meta":
             return {"type": "meta",

@@ -43,6 +43,8 @@ import struct
 import time
 from typing import Any, Optional
 
+from ..obs import metrics as obs_metrics
+from ..obs.trace import stamp as trace_stamp
 from ..protocol.constants import wire_version_lt
 from ..protocol.messages import (
     ClientDetail,
@@ -62,6 +64,26 @@ from .local_server import DeltaConnection, LocalServer
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
+
+# frame-kind label values are drawn from the FIXED protocol vocabulary
+# below, never from client input (bounded cardinality by construction;
+# anything else counts as "unknown")
+_KNOWN_FRAME_KINDS = frozenset((
+    "connect_document", "submitOp", "read_ops", "fetch_summary",
+    "upload_summary_chunk", "disconnect_document", "metrics",
+))
+_FRAMES = obs_metrics.REGISTRY.counter(
+    "ingress_frames_total", "frames dispatched by the ingress",
+    labelnames=("kind",))
+_OPS_IN = obs_metrics.REGISTRY.counter(
+    "ingress_ops_received_total", "raw client ops decoded (incl. "
+    "boxcar members)")
+_BOXCARS = obs_metrics.REGISTRY.counter(
+    "ingress_boxcars_total", "wire-1.2 boxcarred batch submits")
+_NACKS_OUT = obs_metrics.REGISTRY.counter(
+    "ingress_nacks_sent_total", "nack frames sent to clients")
+_ERRORS_OUT = obs_metrics.REGISTRY.counter(
+    "ingress_errors_sent_total", "error frames sent to clients")
 
 # Wire-protocol versions this server speaks (newest first). The
 # reference negotiates `versions` on connect_document
@@ -255,6 +277,7 @@ class AlfredServer:
                 try:
                     self._dispatch(session, frame)
                 except Exception as e:  # noqa: BLE001 - report, keep serving
+                    _ERRORS_OUT.inc()
                     session.send({
                         "type": "error",
                         "rid": frame.get("rid"),
@@ -320,9 +343,31 @@ class AlfredServer:
             )
         session.write_authorized.add(doc)
 
+    def _send_nack(self, session: _ClientSession, doc: str,
+                   nack: Nack) -> None:
+        _NACKS_OUT.inc()
+        session.send({
+            "type": "nack", "document_id": doc, **nack_to_json(nack),
+        })
+
     def _dispatch(self, session: _ClientSession, frame: dict) -> None:
         kind = frame.get("type")
         doc = frame.get("document_id")
+        _FRAMES.labels(
+            kind=kind if kind in _KNOWN_FRAME_KINDS else "unknown"
+        ).inc()
+        if kind == "metrics":
+            # the /metrics-equivalent plane: the process-wide registry
+            # in both expositions (`python -m fluidframework_tpu.
+            # service --dump-metrics` and ops tooling read this).
+            # Unauthenticated by design, like the reference's scraped
+            # metrics ports: names/labels never carry tenant content.
+            session.send({
+                "type": "metrics", "rid": frame.get("rid"),
+                "text": obs_metrics.REGISTRY.render_prometheus(),
+                "metrics": obs_metrics.REGISTRY.snapshot(),
+            })
+            return
         if kind == "connect_document":
             client_id = frame["client_id"]
             details = frame.get("details") or {}
@@ -375,10 +420,8 @@ class AlfredServer:
                     "type": "op", "document_id": d,
                     "msg": message_to_json(msg),
                 }),
-                on_nack=lambda nack, d=doc: session.send({
-                    "type": "nack", "document_id": d,
-                    **nack_to_json(nack),
-                }),
+                on_nack=lambda nack, d=doc: self._send_nack(
+                    session, d, nack),
                 detail=ClientDetail(client_id, **details)
                 if details else None,
                 read_only=(mode == "read"),
@@ -409,12 +452,19 @@ class AlfredServer:
                     f"{session.wire_versions.get(doc, '1.0')})"
                 )
             ops_json = boxcar if boxcar is not None else [frame["op"]]
+            if boxcar is not None:
+                _BOXCARS.inc()
             # decode the WHOLE array before submitting anything: a
             # malformed op mid-boxcar must fail the batch as a unit
             # (error frame, nothing sequenced) — partially ticketing
             # it would put a torn batch on the wire, the exact state
             # the boxcar protocol exists to rule out
             decoded = [document_message_from_json(o) for o in ops_json]
+            _OPS_IN.inc(len(decoded))
+            for op in decoded:
+                # the front-door hop: client-side stamps arrived on
+                # the frame; this marks event-loop receipt
+                trace_stamp(op.traces, "ingress", "receive")
             for op_json, op in zip(ops_json, decoded):
                 try:
                     conn.submit(op)
@@ -422,6 +472,7 @@ class AlfredServer:
                     # read-mode connection: reject as a NACK so the
                     # driver's on_nack fires (parity with the in-proc
                     # path, which raises to the caller directly)
+                    _NACKS_OUT.inc()
                     session.send({
                         "type": "nack", "document_id": doc,
                         "operation": op_json,

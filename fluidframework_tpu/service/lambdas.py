@@ -19,8 +19,16 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
-from ..protocol.messages import MessageType, SequencedMessage, Trace
+from ..obs import metrics as _metrics
+from ..obs.trace import stamp as _stamp
+from ..protocol.messages import MessageType, SequencedMessage
 from ..protocol.quorum import ProtocolOpHandler
+
+_BROADCASTS = _metrics.REGISTRY.counter(
+    "broadcaster_fanouts_total",
+    "sequenced messages fanned out to subscribers")
+_OPLOG_WRITES = _metrics.REGISTRY.counter(
+    "scriptorium_writes_total", "sequenced ops persisted to the log")
 
 
 class OpLog:
@@ -83,7 +91,8 @@ class ScriptoriumLambda:
         self.op_log = op_log
 
     def handler(self, msg: SequencedMessage) -> None:
-        msg.traces.append(Trace("scriptorium", "write"))
+        _stamp(msg.traces, "scriptorium", "write")
+        _OPLOG_WRITES.inc()
         self.op_log.append(msg)
 
 
@@ -130,7 +139,8 @@ class BroadcasterLambda:
         self._subscribers.pop(subscriber_id, None)
 
     def handler(self, msg: SequencedMessage) -> None:
-        msg.traces.append(Trace("broadcaster", "fanout"))
+        _stamp(msg.traces, "broadcaster", "fanout")
+        _BROADCASTS.inc()
         for handler in list(self._subscribers.values()):
             handler(msg)
 
@@ -230,7 +240,7 @@ class ScribeLambda:
         self._op_log = op_log
 
     def handler(self, msg: SequencedMessage) -> None:
-        msg.traces.append(Trace("scribe", "process"))
+        _stamp(msg.traces, "scribe", "process")
         self.protocol.process_message(msg)
         if msg.type == MessageType.SUMMARIZE:
             self._handle_summarize(msg)

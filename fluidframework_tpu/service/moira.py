@@ -35,8 +35,18 @@ import socket
 import threading
 from typing import Any, Callable, Optional
 
+from ..obs import metrics as obs_metrics
 from ..protocol.messages import MessageType, SequencedMessage
 from .ingress import pack_frame, read_frame, recv_frame_blocking
+
+_COMMITS_PUBLISHED = obs_metrics.REGISTRY.counter(
+    "moira_commits_published_total",
+    "changeset commits published to materialized history")
+_BRANCHES_CREATED = obs_metrics.REGISTRY.counter(
+    "moira_branches_created_total", "MH branches created")
+_FLUSH_FAILURES = obs_metrics.REGISTRY.counter(
+    "moira_flush_failures_total",
+    "publish batches restored for at-least-once replay")
 
 
 def derived_guid(reference_guid: str, identifier: str) -> str:
@@ -323,6 +333,7 @@ class MoiraLambda:
                             branch, derived_guid(branch, "root"),
                             meta={"documentId": self.document_id},
                         )
+                        _BRANCHES_CREATED.inc()
                     commit = derived_guid(branch, f"commit-{seq}")
                     self.client.create_commit(
                         branch, commit, parent,
@@ -335,8 +346,10 @@ class MoiraLambda:
                     self.heads[branch] = commit
                     n += 1
             self.published += n
+            _COMMITS_PUBLISHED.inc(n)
         except Exception:
             # restore for replay (context.error(restart) equivalent)
+            _FLUSH_FAILURES.inc()
             for b, items in current.items():
                 self.pending.setdefault(b, [])[:0] = items
             self._pending_offset = offset

@@ -25,6 +25,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
+from ..obs import metrics as _metrics
+from ..obs.trace import stamp as _stamp
 from ..protocol.messages import (
     ClientDetail,
     DocumentMessage,
@@ -32,8 +34,17 @@ from ..protocol.messages import (
     Nack,
     NackErrorType,
     SequencedMessage,
-    Trace,
 )
+
+# process-wide aggregates across every document's sequencer (label-
+# free on purpose: per-document label sets are unbounded cardinality)
+_TICKETS = _metrics.REGISTRY.counter(
+    "sequencer_tickets_total", "raw ops assigned a sequence number")
+_NACKS = _metrics.REGISTRY.counter(
+    "sequencer_nacks_total", "raw ops refused by the sequencer")
+_SYSTEM_MSGS = _metrics.REGISTRY.counter(
+    "sequencer_system_messages_total",
+    "service-generated sequenced messages (joins/leaves/acks)")
 
 
 @dataclass
@@ -115,6 +126,7 @@ class DocumentSequencer:
         """Assign seq + msn to one raw client op, or nack it."""
         client = self._clients.get(client_id)
         if client is None:
+            _NACKS.inc()
             return TicketResult(nack=Nack(
                 operation=op,
                 sequence_number=self.sequence_number,
@@ -129,6 +141,7 @@ class DocumentSequencer:
             # Duplicate delivery: drop silently (idempotence).
             return TicketResult()
         if op.client_sequence_number > expected:
+            _NACKS.inc()
             return TicketResult(nack=Nack(
                 operation=op,
                 sequence_number=self.sequence_number,
@@ -141,6 +154,7 @@ class DocumentSequencer:
 
         # refSeq sanity: must be inside the collab window.
         if op.reference_sequence_number < self.minimum_sequence_number:
+            _NACKS.inc()
             return TicketResult(nack=Nack(
                 operation=op,
                 sequence_number=self.sequence_number,
@@ -151,6 +165,7 @@ class DocumentSequencer:
                 ),
             ))
         if op.reference_sequence_number > self.sequence_number:
+            _NACKS.inc()
             return TicketResult(nack=Nack(
                 operation=op,
                 sequence_number=self.sequence_number,
@@ -164,8 +179,10 @@ class DocumentSequencer:
 
         seq = self._next_seq()
         msn = self._compute_msn()
-        traces = list(op.traces)
-        traces.append(Trace("sequencer", "ticket"))
+        _TICKETS.inc()
+        # the deli stamp (deli/lambda.ts:1130): the op's client-side
+        # hops travel with it; this marks the ordering authority
+        traces = _stamp(list(op.traces), "sequencer", "ticket")
         return TicketResult(message=SequencedMessage(
             client_id=client_id,
             sequence_number=seq,
@@ -183,6 +200,7 @@ class DocumentSequencer:
                        contents: Any) -> SequencedMessage:
         """Allocate a seq for a service-generated op (scribe's
         summaryAck/Nack loop back through deli the same way)."""
+        _SYSTEM_MSGS.inc()
         return self._stamp_system(msg_type, contents, self._next_seq())
 
     # ------------------------------------------------------------------

@@ -46,7 +46,35 @@ class Lumber:
         self._complete(False, message)
 
     def _complete(self, successful: bool, message: str) -> None:
-        assert not self._emitted, "lumber emitted twice"
+        if self._emitted:
+            # A double-completion is a caller bug, but the old
+            # ``assert`` guard vanished under ``python -O`` (silent
+            # double emit) and crashed the service path otherwise
+            # (interpreter-dependent behavior either way). Record it
+            # LOUDLY as its own error event instead: the first
+            # emission stands, the duplicate becomes evidence.
+            from ..obs import metrics as _metrics
+
+            _metrics.REGISTRY.counter(
+                "telemetry_lumber_double_emit_total",
+                "Lumber success()/error() called after completion",
+            ).inc()
+            dup = Lumber(
+                f"{self.event_name}:doubleEmit", LumberType.LOG,
+                self._engines, dict(self.properties),
+            )
+            dup.properties["firstOutcome"] = self.successful
+            dup.properties["secondOutcome"] = successful
+            dup._emitted = True
+            dup.duration_ms = 0.0
+            dup.successful = False
+            dup.message = (
+                f"lumber {self.event_name!r} completed twice "
+                f"(second message: {message!r})"
+            )
+            for engine in self._engines:
+                engine.emit(dup)
+            return
         self._emitted = True
         self.duration_ms = (time.time() - self.start_time) * 1000
         self.successful = successful

@@ -51,6 +51,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.mergetree import MergeTreeClient
+from ..obs import metrics as obs_metrics
+from ..obs.flight_recorder import FlightRecorder
+from ..obs.trace import stamp as trace_stamp
 from ..ops import (
     DocStream,
     OpBatch,
@@ -75,6 +78,46 @@ from ..protocol.messages import MessageType, SequencedMessage
 # chunk length of the service-side chunked dispatches (must be <= 31;
 # 8 matches the bench-proven sweet spot, ops/merge_chunk.py)
 CHUNK_K = 8
+
+# Registry families (process aggregates across every sidecar/pool
+# instance; exact per-instance counts stay on the owning object —
+# tests read sidecar.grow_count etc.). IMPORTANT: everything bumped
+# from inside the dispatch loop is host-side only — a registry inc and
+# a flight-recorder record never touch the device; fluidlint's
+# dispatch-loop-sync rule covers these call sites.
+_M_ROUNDS = obs_metrics.REGISTRY.counter(
+    "sidecar_rounds_total", "dispatch rounds flushed")
+_M_OPS = obs_metrics.REGISTRY.counter(
+    "sidecar_real_ops_total", "non-noop ops applied on device")
+_M_GROW = obs_metrics.REGISTRY.counter(
+    "sidecar_grow_total", "capacity-ladder regrows")
+_M_EVICT = obs_metrics.REGISTRY.counter(
+    "sidecar_evict_total", "documents evicted to host replicas")
+_M_POOL_ADMIT = obs_metrics.REGISTRY.counter(
+    "sidecar_pool_admit_total", "documents admitted to the seq pool")
+_M_RECOVER = obs_metrics.REGISTRY.counter(
+    "sidecar_overflow_recoveries_total",
+    "settle boundaries that found the overflow flag set")
+_M_PACK_MS = obs_metrics.REGISTRY.histogram(
+    "sidecar_pack_ms", "host half of a round (pack + compile)")
+_M_SETTLE_MS = obs_metrics.REGISTRY.histogram(
+    "sidecar_settle_ms", "device-wait at the settle boundary")
+_M_TRACKED = obs_metrics.REGISTRY.gauge(
+    "sidecar_tracked_channels", "channels on the device batch path")
+_M_POOLED = obs_metrics.REGISTRY.gauge(
+    "sidecar_pooled_docs", "documents on the seq-sharded pool tier")
+_M_HOSTED = obs_metrics.REGISTRY.gauge(
+    "sidecar_host_docs", "documents evicted to host replicas")
+_M_CAPACITY = obs_metrics.REGISTRY.gauge(
+    "sidecar_capacity", "current primary slab capacity (slots/doc)")
+_M_POOL_DISPATCH = obs_metrics.REGISTRY.counter(
+    "pool_dispatches_total", "seq-pool incremental dispatches")
+_M_POOL_DEPTH = obs_metrics.REGISTRY.gauge(
+    "pool_dispatch_depth", "ops in the last pool dispatch")
+_M_POOL_WATERMARK = obs_metrics.REGISTRY.gauge(
+    "pool_watermark_ops", "sum of member stream watermarks")
+_M_POOL_MEMBERS = obs_metrics.REGISTRY.gauge(
+    "pool_members", "documents admitted to the pool")
 
 
 def default_executor() -> str:
@@ -211,6 +254,10 @@ class SeqShardedPool:
         # racing a recovery rebuild is impossible by construction.
         self.applied_upto: dict[int, int] = {}
         self._table = None
+        # per-instance observability counters (registry families hold
+        # the process aggregates)
+        self.dispatch_count = 0
+        self.last_dispatch_depth = 0
 
     def _bucket(self) -> int:
         n = max(1, len(self.members))
@@ -257,6 +304,8 @@ class SeqShardedPool:
         self.applied_upto = {
             slot: len(streams[slot].ops) for slot in self.members
         }
+        _M_POOL_MEMBERS.set(len(self.members))
+        _M_POOL_WATERMARK.set(sum(self.applied_upto.values()))
 
     def admit(self, slots: list, streams) -> list:
         """Admit sidecar slots; returns the slots that FAILED (exceed
@@ -310,9 +359,15 @@ class SeqShardedPool:
                 upto[slot] = len(streams[slot].ops)
         if not pending:
             return []
+        depth = sum(len(ops) for ops in pending.values())
+        self.dispatch_count += 1
+        self.last_dispatch_depth = depth
+        _M_POOL_DISPATCH.inc()
+        _M_POOL_DEPTH.set(depth)
         arrays = _pack_rows(self._table.docs, pending)
         self._table = self._apply(self._table, arrays)
         self.applied_upto.update(upto)
+        _M_POOL_WATERMARK.set(sum(self.applied_upto.values()))
         return self.overflowed_slots()
 
     def overflowed_slots(self) -> list:
@@ -343,10 +398,35 @@ class TpuMergeSidecar:
                  executor: Optional[str] = None,
                  pipeline: Optional[bool] = None,
                  donate: Optional[bool] = None,
-                 ladder: Optional[BucketLadder] = None):
+                 ladder: Optional[BucketLadder] = None,
+                 trace_ops: Optional[bool] = None):
         self.max_docs = max_docs
         self.capacity = capacity
         self.max_capacity = max_capacity
+        # per-op trace stamping (sidecar:pack / sidecar:settle hops on
+        # the ingested messages' trace lists). OPT-IN: it costs one
+        # Python append per op per round on the serving path, so the
+        # default stays off; the op-trace example and tests enable it.
+        if trace_ops is not None:
+            self.trace_ops = trace_ops
+        else:
+            env_trace = os.environ.get("FFTPU_SIDECAR_TRACE")
+            if env_trace and env_trace not in ("0", "1"):
+                raise ValueError(
+                    f"FFTPU_SIDECAR_TRACE={env_trace!r}: expected "
+                    "'0' or '1'"
+                )
+            self.trace_ops = env_trace == "1"
+        # messages ingested since the last dispatch / packed into the
+        # in-flight round (trace_ops bookkeeping; cleared every round)
+        self._round_msgs: list[SequencedMessage] = []
+        self._inflight_msgs: list[SequencedMessage] = []
+        self.last_settled_msgs: list[SequencedMessage] = []
+        # dispatch-loop flight recorder: last N rounds' host-side
+        # events, dumped automatically when _settle finds the overflow
+        # flag set (the postmortem the PR-2 stall lacked)
+        self.flight = FlightRecorder(256, name="sidecar")
+        self.last_flight_dump: Optional[str] = None
         # dispatch-route knobs (env-overridable escape hatches)
         self.executor = executor or default_executor()
         if pipeline is not None:
@@ -424,6 +504,7 @@ class TpuMergeSidecar:
         # pipeline instrumentation (bench config7 reads these):
         # host-pack seconds vs settle (device-wait) seconds per round
         self.stats = {"pack_s": 0.0, "settle_s": 0.0, "rounds": 0}
+        _M_CAPACITY.set(self.capacity)
 
     # ------------------------------------------------------------------
     # registration + ingest
@@ -442,6 +523,7 @@ class TpuMergeSidecar:
         )
         self._streams.append(DocStream())
         self._queued.append([])
+        _M_TRACKED.set(len(self._streams))
         return slot
 
     def subscribe(self, server, document_id: str, datastore_id: str,
@@ -464,6 +546,17 @@ class TpuMergeSidecar:
         """Consume one sequenced message of a document: channel ops for
         tracked channels encode as kernel ops; everything else becomes
         a NOOP that still advances the collab window."""
+        if self.trace_ops and any(
+            slot not in self._host
+            for slot, _, _ in self._doc_slots.get(document_id, ())
+        ):
+            # one entry per ingested message: the pack/settle hops of
+            # the round that carries it stamp this object later
+            # (dataclasses.replace below shares the traces list, so
+            # stamps land on the original message too). Fully-evicted
+            # docs skip this — their ops never reach a dispatch round,
+            # so buffering them here would grow without bound.
+            self._round_msgs.append(msg)
         for slot, ds_id, ch_id in self._doc_slots.get(document_id, ()):
             stream = self._streams[slot]
             envelope = msg.contents if isinstance(msg.contents, dict) else {}
@@ -650,8 +743,24 @@ class TpuMergeSidecar:
         )
         for queue in self._queued:
             queue.clear()
-        self.stats["pack_s"] += time.perf_counter() - t0
+        pack_s = time.perf_counter() - t0
+        self.stats["pack_s"] += pack_s
         self.stats["rounds"] += 1
+        _M_ROUNDS.inc()
+        _M_OPS.inc(real + pool_real)
+        _M_PACK_MS.observe(pack_s * 1000.0)
+        # host-side round record (timestamps + already-host scalars
+        # only — nothing here may read the device)
+        self.flight.record(
+            "dispatch", round=self.stats["rounds"], real_ops=real,
+            pool_ops=pool_real, pack_ms=round(pack_s * 1000.0, 3),
+            capacity=self.capacity,
+        )
+        if self.trace_ops and self._round_msgs:
+            pack_t = time.time()
+            for m in self._round_msgs:
+                trace_stamp(m.traces, "sidecar", "pack",
+                            timestamp=pack_t)
         # SYNC BOUNDARY — read the previous round's overflow flag
         # (recovery if set) before its snapshot is retired below.
         self._settle()
@@ -671,6 +780,11 @@ class TpuMergeSidecar:
         self._prev_table = self._table
         self._last_program = program
         self._unsettled = True
+        # _settle above closed the PREVIOUS round's trace window; this
+        # round's messages are now the in-flight set
+        if self.trace_ops:
+            self._inflight_msgs = self._round_msgs
+            self._round_msgs = []
         self._table = self._apply_program(
             self._prev_table, program, dead if self.donate else None
         )
@@ -688,8 +802,29 @@ class TpuMergeSidecar:
             self._unsettled = False
             t0 = time.perf_counter()
             overflowed = bool(np.asarray(self._table.overflow).any())
-            self.stats["settle_s"] += time.perf_counter() - t0
+            settle_s = time.perf_counter() - t0
+            self.stats["settle_s"] += settle_s
+            _M_SETTLE_MS.observe(settle_s * 1000.0)
+            # `overflowed` is a pre-fetched host bool by now — the
+            # flight record costs no extra device read
+            self.flight.record(
+                "settle", settle_ms=round(settle_s * 1000.0, 3),
+                overflow=overflowed,
+            )
+            if self.trace_ops and self._inflight_msgs:
+                settle_t = time.time()
+                for m in self._inflight_msgs:
+                    trace_stamp(m.traces, "sidecar", "settle",
+                                timestamp=settle_t)
+                self.last_settled_msgs = self._inflight_msgs
+                self._inflight_msgs = []
             if overflowed:
+                _M_RECOVER.inc()
+                # the automatic postmortem: what the dispatch loop did
+                # in the rounds leading up to the overflow
+                self.last_flight_dump = self.flight.dump_to(
+                    reason="_settle found the overflow flag set "
+                           "(recovery running)")
                 self._recover()
                 # recovery re-applied at a new capacity: retired
                 # buffers of the old shape are useless as fodder
@@ -747,7 +882,10 @@ class TpuMergeSidecar:
         from ..ops.merge_kernel import pad_capacity
 
         self.grow_count += 1
+        _M_GROW.inc()
         self.capacity = new_capacity
+        _M_CAPACITY.set(new_capacity)
+        self.flight.record("recover-grow", capacity=new_capacity)
         if self._prev_table is None:  # pragma: no cover - first flush
             self._prev_table = make_table(self.max_docs, new_capacity)
         else:
@@ -794,9 +932,12 @@ class TpuMergeSidecar:
         # watermark, so nothing it subsumed can dispatch again)
         failed = self._pool.admit(fresh, self._streams) if fresh else []
         admitted = [s for s in slots if s not in failed]
-        self.pool_admit_count += len(
-            [s for s in fresh if s not in failed]
-        )
+        newly = len([s for s in fresh if s not in failed])
+        self.pool_admit_count += newly
+        _M_POOL_ADMIT.inc(newly)
+        _M_POOLED.set(len(self._pool.members))
+        self.flight.record("recover-pool", admitted=newly,
+                           failed=len(failed))
         self._retire_rows(admitted)
         for slot in admitted:
             self._queued[slot].clear()  # replayed from the stream
@@ -817,6 +958,8 @@ class TpuMergeSidecar:
         from ..ops.host_bridge import decode_stream
 
         self.evict_count += 1
+        _M_EVICT.inc()
+        self.flight.record("recover-evict", slot=slot)
         if self._pool is not None and slot in self._pool.row_of:
             # remove() is bookkeeping only: rebuild HERE so every
             # eviction path (dispatch overflow, ingest's
@@ -827,6 +970,9 @@ class TpuMergeSidecar:
         obs = MergeTreeClient(f"sidecar-host-{slot}")
         obs.start_collaboration(f"sidecar-host-{slot}")
         self._host[slot] = obs
+        _M_HOSTED.set(len(self._host))
+        if self._pool is not None:
+            _M_POOLED.set(len(self._pool.members))
         self._queued[slot].clear()
         for msg in decode_stream(self._streams[slot]):
             obs.apply_msg(msg)

@@ -45,7 +45,9 @@ def worker_main(host: str, port: int, document_id: str,
     """Body of one stress client (runs in its own OS process)."""
     from ..drivers.socket_driver import SocketDocumentService
     from ..loader import Container
+    from ..obs import metrics as obs_metrics
 
+    metrics_before = obs_metrics.REGISTRY.flat()
     svc = SocketDocumentService(host, port, document_id)
     # the dispatch thread mutates the container under svc.lock; load
     # (connect, channel collab renames) must hold it too
@@ -130,6 +132,10 @@ def worker_main(host: str, port: int, document_id: str,
         "client_id": client_id,
         "text_sha": hashlib.sha256(final.encode()).hexdigest(),
         "length": len(final),
+        # this worker's registry movement (fresh process, so the
+        # delta is its whole story: ops submitted/acked, frames,
+        # roundtrip histogram buckets)
+        "metrics_delta": obs_metrics.REGISTRY.delta(metrics_before),
     }
 
 
@@ -214,10 +220,16 @@ def run_net_stress(n_workers: int = 3, n_ops: int = 30,
                 f"vs workers {[r['length'] for r in reports]}; "
                 f"replay text {replay_text[:80]!r}"
             )
+        from ..obs import metrics as obs_metrics
+
         return {
             "workers": reports,
             "converged_sha": hashes.pop(),
             "replay_length": len(replay_text),
+            # the validator's own registry view (per-worker deltas
+            # ride inside each worker report); delta({}) = nonzero
+            # series only
+            "metrics_delta": obs_metrics.REGISTRY.delta({}),
         }
     finally:
         server.kill()

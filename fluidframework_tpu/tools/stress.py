@@ -16,6 +16,7 @@ from typing import Optional
 
 from ..drivers.local_driver import LocalDocumentServiceFactory
 from ..loader.container import Container
+from ..obs import metrics as obs_metrics
 from ..service.local_server import LocalServer
 from ..testing.fault_injection import FaultInjectionDocumentService
 
@@ -49,6 +50,9 @@ class StressReport:
     converged: bool = False
     final_text: str = ""
     errors: list[str] = field(default_factory=list)
+    # what the run moved in the unified metrics registry (nonzero
+    # deltas of the flat view — ops, nacks, roundtrip histograms...)
+    metrics_delta: dict = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -59,6 +63,7 @@ def run_stress(config: Optional[StressConfig] = None) -> StressReport:
     cfg = config or StressConfig()
     rng = random.Random(cfg.seed)
     report = StressReport()
+    metrics_before = obs_metrics.REGISTRY.flat()
 
     server = LocalServer()
     factory = LocalDocumentServiceFactory(server)
@@ -165,6 +170,7 @@ def run_stress(config: Optional[StressConfig] = None) -> StressReport:
     if not report.converged:
         report.errors.append(f"divergence: texts={texts}")
     report.final_text = next(iter(texts.values()))
+    report.metrics_delta = obs_metrics.REGISTRY.delta(metrics_before)
     return report
 
 
@@ -187,6 +193,7 @@ def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
         "nacks": report.nacks_injected,
         "converged": report.converged,
         "errors": report.errors,
+        "metrics_delta": report.metrics_delta,
     }))
     return 0 if report.ok else 1
 

@@ -121,17 +121,30 @@ class MockLogger(TelemetryLogger):
 
 class PerformanceEvent:
     """logger.ts:410 — a timed span; use as a context manager. On
-    exception the event reports ``cancel`` with the error."""
+    exception the event reports ``cancel`` with the error.
+
+    ``emit_start=True`` additionally emits ``<name>_start`` when the
+    span OPENS (the reference's PerformanceEvent.start), so a
+    long-running span is visible in the event stream before it ends —
+    without it, a span that hangs (the ack-deadline shape) leaves no
+    telemetry at all until the timeout fires."""
 
     def __init__(self, logger: TelemetryLogger, event_name: str,
-                 **props: Any):
+                 emit_start: bool = False, **props: Any):
         self.logger = logger
         self.event_name = event_name
+        self.emit_start = emit_start
         self.props = props
         self._start = None
 
     def __enter__(self) -> "PerformanceEvent":
         self._start = time.monotonic()
+        if self.emit_start:
+            self.logger.send({
+                "eventName": f"{self.event_name}_start",
+                "category": "performance",
+                **self.props,
+            })
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
@@ -149,7 +162,14 @@ class PerformanceEvent:
 
 class SampledTelemetryHelper:
     """sampledTelemetryHelper.ts — aggregate N measurements into one
-    event (count/min/max/mean duration)."""
+    event (count/min/max/mean duration).
+
+    Use as a context manager (or call :meth:`close`) so a TAIL of
+    fewer than ``sample_every`` measurements flushes at teardown
+    instead of being silently dropped — a short-lived container used
+    to lose every measurement under the threshold. The obs shutdown
+    path (``fluidframework_tpu.obs.shutdown``) closes registered
+    helpers the same way."""
 
     def __init__(self, logger: TelemetryLogger, event_name: str,
                  sample_every: int = 100):
@@ -157,6 +177,7 @@ class SampledTelemetryHelper:
         self.event_name = event_name
         self.sample_every = sample_every
         self._durations: list[float] = []
+        self.closed = False
 
     def measure(self, fn: Callable[[], Any]) -> Any:
         start = time.monotonic()
@@ -169,6 +190,18 @@ class SampledTelemetryHelper:
         self._durations.append(duration_ms)
         if len(self._durations) >= self.sample_every:
             self.flush()
+
+    def close(self) -> None:
+        """Flush the tail; idempotent (safe to close again from the
+        obs shutdown path after an owner already closed it)."""
+        self.flush()
+        self.closed = True
+
+    def __enter__(self) -> "SampledTelemetryHelper":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
     def flush(self) -> None:
         if not self._durations:

@@ -204,6 +204,17 @@ def test_delivery_fault_tears_down_loudly_not_silently(server):
     assert svc_a.last_error is not None and \
         "injected delivery fault" in svc_a.last_error
     assert svc_a._closed, "faulted transport must tear down"
+    # the teardown ships a flight-recorder dump naming the last N
+    # transport events — the postmortem the original stall lacked
+    assert svc_a.last_flight_dump is not None
+    assert "dispatch fault teardown" in svc_a.last_flight_dump
+    assert "dispatch-fault" in svc_a.last_flight_dump
+    assert "recv" in svc_a.last_flight_dump, (
+        "the dump must name the frames that led up to the fault"
+    )
+    assert "type='op'" in svc_a.last_flight_dump, (
+        "the faulting op broadcast should be among the recent events"
+    )
     # B is unaffected, and a reloaded A catches up over a fresh
     # connection (the op log is the durable source)
     with svc_b.lock:

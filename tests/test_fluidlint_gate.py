@@ -47,6 +47,67 @@ def test_allowlist_ratchet_cap():
     )
 
 
+def test_obs_untimed_hop_rule_fires_on_unregistered_hops(tmp_path):
+    """The obs-untimed-hop rule (obscheck family): a module stamping
+    a hop name outside the canonical table in obs/trace.py fails; a
+    canonical stamp passes. Covers both the stamp() call form and a
+    direct Trace(...) construction."""
+    fixture = tmp_path / "bad_hops.py"
+    fixture.write_text(
+        "from fluidframework_tpu.obs.trace import stamp\n"
+        "from fluidframework_tpu.protocol.messages import Trace\n"
+        "def f(traces):\n"
+        "    stamp(traces, 'client', 'submit')\n"       # canonical
+        "    stamp(traces, 'warpdrive', 'engage')\n"    # not
+        "    traces.append(Trace('sequencer', 'ticket'))\n"  # canonical
+        "    traces.append(Trace('gremlin', 'nibble'))\n"    # not
+        "    name = 'dyn'\n"
+        "    stamp(traces, name, name)\n"  # dynamic: runtime's job
+    )
+    findings = core.run_analysis(
+        roots=[str(fixture)], families=["obscheck"],
+    )
+    keys = sorted(f.key for f in findings)
+    assert keys == [
+        "bad_hops.py:gremlin:nibble",
+        "bad_hops.py:warpdrive:engage",
+    ]
+    assert all(f.rule == "obs-untimed-hop" for f in findings)
+
+    # a module's own unrelated stamp()/Trace() — no obs/protocol
+    # import — must NOT false-positive the gate
+    unrelated = tmp_path / "unrelated.py"
+    unrelated.write_text(
+        "def stamp(canvas, layer, mode):\n"
+        "    return (canvas, layer, mode)\n"
+        "class Trace:\n"
+        "    def __init__(self, a, b):\n"
+        "        pass\n"
+        "def g(c):\n"
+        "    stamp(c, 'fill', 'round')\n"
+        "    Trace('not', 'a-hop')\n"
+    )
+    assert core.run_analysis(
+        roots=[str(unrelated)], families=["obscheck"],
+    ) == []
+
+
+def test_obs_canonical_table_stays_statically_readable():
+    """obscheck must keep extracting the hop table without importing
+    the obs package (the linter depends on nothing it lints); this
+    breaks loudly if CANONICAL_HOPS stops being a pure literal."""
+    from fluidframework_tpu.analysis.obscheck import load_canonical_hops
+
+    hops = load_canonical_hops()
+    assert ("sequencer", "ticket") in hops
+    assert ("client", "submit") in hops
+    assert ("sidecar", "settle") in hops
+
+
+def test_obscheck_family_is_in_the_gate():
+    assert "obscheck" in core.FAMILIES
+
+
 def test_cli_json_mode_exits_zero_on_clean_tree():
     """The `--json` surface BENCH/ADVICE tooling consumes: exit 0 and
     a well-formed empty report on a clean tree."""

@@ -1,0 +1,456 @@
+"""The observability subsystem: trace stamping/breakdowns, the
+metrics registry, the flight recorder, the telemetry satellites, and
+the per-op latency ledger."""
+import io
+import json
+
+import pytest
+
+from fluidframework_tpu import obs
+from fluidframework_tpu.obs import metrics as obs_metrics
+from fluidframework_tpu.obs.flight_recorder import FlightRecorder
+from fluidframework_tpu.obs.trace import (
+    CANONICAL_HOPS,
+    breakdown,
+    format_breakdown,
+    stamp,
+    total_ms,
+)
+
+
+# ======================================================================
+# trace
+
+
+def test_stamp_appends_canonical_hops_in_order():
+    traces = stamp([], "client", "submit", timestamp=10.0)
+    stamp(traces, "sequencer", "ticket", timestamp=10.5)
+    stamp(traces, "client", "ack", timestamp=11.0)
+    rows = breakdown(traces)
+    assert [r["hop"] for r in rows] == [
+        "client:submit", "sequencer:ticket", "client:ack",
+    ]
+    assert rows[0]["delta_ms"] == 0.0
+    assert rows[1]["delta_ms"] == pytest.approx(500.0)
+    assert total_ms(traces) == pytest.approx(1000.0)
+
+
+def test_stamp_rejects_unregistered_hop():
+    with pytest.raises(ValueError, match="unknown trace hop"):
+        stamp([], "warpdrive", "engage")  # fluidlint: disable=obs-untimed-hop -- the rule under test
+
+
+def test_breakdown_orders_by_timestamp_not_append_order():
+    # sidecar hops are appended AFTER the client ack (they stamp at
+    # settle time); the breakdown must present true time order
+    traces = stamp([], "client", "submit", timestamp=1.0)
+    stamp(traces, "client", "ack", timestamp=2.0)
+    stamp(traces, "sidecar", "pack", timestamp=1.5)
+    assert [r["hop"] for r in breakdown(traces)] == [
+        "client:submit", "sidecar:pack", "client:ack",
+    ]
+
+
+def test_format_breakdown_mentions_every_hop():
+    traces = stamp([], "client", "submit")
+    stamp(traces, "driver", "send")
+    text = format_breakdown(traces)
+    assert "client:submit" in text and "driver:send" in text
+    assert "total" in text
+
+
+def test_canonical_table_is_a_pure_literal():
+    """obscheck extracts the table with ast.literal_eval; a computed
+    value would break the static rule."""
+    import ast
+
+    from fluidframework_tpu.analysis.obscheck import (
+        load_canonical_hops,
+    )
+
+    assert load_canonical_hops() == set(CANONICAL_HOPS)
+    # and every pair is (str, str)
+    for service, action in CANONICAL_HOPS:
+        assert isinstance(service, str) and isinstance(action, str)
+    del ast
+
+
+# ======================================================================
+# metrics registry
+
+
+def test_counter_gauge_histogram_roundtrip():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("ops_total", "ops")
+    g = reg.gauge("depth", "queue depth")
+    h = reg.histogram("lat_ms", "latency", buckets=(1.0, 10.0))
+    c.inc()
+    c.inc(2)
+    g.set(7)
+    g.dec()
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(100.0)
+    snap = reg.snapshot()
+    assert snap["ops_total"]["values"][""] == 3.0
+    assert snap["depth"]["values"][""] == 6.0
+    hist = snap["lat_ms"]["values"][""]
+    assert hist["count"] == 3
+    assert hist["sum"] == pytest.approx(105.5)
+    assert hist["buckets"]["1.0"] == 1
+    assert hist["buckets"]["10.0"] == 2     # cumulative
+    assert hist["buckets"]["+Inf"] == 3
+
+
+def test_counter_rejects_negative_and_labels_enforced():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("x_total", labelnames=("kind",))
+    with pytest.raises(ValueError, match="labels"):
+        c.inc()  # labeled family needs .labels()
+    c.labels(kind="a").inc()
+    c.labels(kind="a").inc()
+    c.labels(kind="b").inc()
+    assert reg.snapshot()["x_total"]["values"] == {
+        '{kind="a"}': 2.0, '{kind="b"}': 1.0,
+    }
+    with pytest.raises(ValueError, match="only go up"):
+        c.labels(kind="a").inc(-1)
+    with pytest.raises(ValueError, match="do not match"):
+        c.labels(wrong="a")
+
+
+def test_reregistration_same_family_mismatch_loud():
+    reg = obs_metrics.MetricsRegistry()
+    a = reg.counter("dup_total", "first")
+    b = reg.counter("dup_total", "second")
+    assert a is b
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("dup_total")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("dup_total", labelnames=("k",))
+
+
+def test_prometheus_rendering_parses_as_exposition():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("a_total", "help text").inc(5)
+    reg.histogram("b_ms", buckets=(1.0,)).observe(0.5)
+    reg.gauge("g", labelnames=("kind",)).labels(kind="x").set(2)
+    text = reg.render_prometheus()
+    assert "# HELP a_total help text" in text
+    assert "# TYPE a_total counter" in text
+    assert "a_total 5.0" in text
+    assert 'b_ms_bucket{le="1.0"} 1' in text
+    assert 'b_ms_bucket{le="+Inf"} 1' in text
+    assert "b_ms_count 1" in text
+    assert 'g{kind="x"} 2.0' in text
+    # the snapshot is JSON-able (bench embeds it in stage records)
+    json.dumps(reg.snapshot())
+
+
+def test_flat_and_delta():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("n_total")
+    h = reg.histogram("d_ms")
+    c.inc(2)
+    before = reg.flat()
+    c.inc(3)
+    h.observe(1.0)
+    delta = reg.delta(before)
+    assert delta["n_total"] == 3.0
+    assert delta["d_ms_count"] == 1
+    # unchanged series are omitted
+    c2 = reg.counter("quiet_total")
+    assert "quiet_total" not in reg.delta(before)
+    del c2
+
+
+def test_reset_zeroes_in_place():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("z_total")
+    c.inc(4)
+    reg.reset()
+    assert c.value == 0.0
+    c.inc()  # the held handle still works
+    assert reg.flat()["z_total"] == 1.0
+
+
+def test_global_registry_shared():
+    assert obs_metrics.get_registry() is obs_metrics.REGISTRY
+    assert obs.REGISTRY is obs_metrics.REGISTRY
+
+
+# ======================================================================
+# flight recorder
+
+
+def test_flight_recorder_ring_overwrites_oldest():
+    fr = FlightRecorder(capacity=4, name="t")
+    for i in range(10):
+        fr.record("tick", i=i)
+    events = fr.events()
+    assert len(events) == 4
+    assert [e[3]["i"] for e in events] == [6, 7, 8, 9]
+    assert fr.recorded == 10
+    dump = fr.dump(reason="test")
+    assert "6 older overwritten" in dump
+    assert "flight-recorder[t]" in dump
+    assert "i=9" in dump
+
+
+def test_flight_recorder_dump_last_n_and_stream():
+    fr = FlightRecorder(capacity=16)
+    for i in range(5):
+        fr.record("ev", n=i)
+    sink = io.StringIO()
+    text = fr.dump_to(reason="teardown", stream=sink, last=2)
+    assert text == sink.getvalue().rstrip("\n")
+    assert "n=3" in text and "n=4" in text and "n=2" not in text
+    assert "teardown" in text
+
+
+def test_flight_recorder_empty_dump():
+    assert "(empty)" in FlightRecorder(capacity=2).dump()
+
+
+# ======================================================================
+# telemetry satellites
+
+
+def test_sampled_helper_close_flushes_tail():
+    from fluidframework_tpu.utils.telemetry import (
+        MockLogger,
+        SampledTelemetryHelper,
+    )
+
+    logger = MockLogger()
+    helper = SampledTelemetryHelper(logger, "lat", sample_every=100)
+    helper.record(5.0)
+    helper.record(7.0)
+    assert logger.events == []  # below the threshold: not yet flushed
+    helper.close()
+    assert len(logger.events) == 1
+    assert logger.events[0]["count"] == 2
+    helper.close()  # idempotent
+    assert len(logger.events) == 1
+
+
+def test_sampled_helper_context_manager_flushes():
+    from fluidframework_tpu.utils.telemetry import (
+        MockLogger,
+        SampledTelemetryHelper,
+    )
+
+    logger = MockLogger()
+    with SampledTelemetryHelper(logger, "lat", sample_every=50) as h:
+        h.record(1.0)
+    assert len(logger.events) == 1 and logger.events[0]["count"] == 1
+
+
+def test_obs_shutdown_flushes_registered_helpers():
+    from fluidframework_tpu.utils.telemetry import (
+        MockLogger,
+        SampledTelemetryHelper,
+    )
+
+    logger = MockLogger()
+    helper = SampledTelemetryHelper(logger, "lat", sample_every=50)
+    obs.register_closeable(helper)
+    helper.record(3.0)
+    obs.shutdown()
+    assert len(logger.events) == 1
+    assert helper.closed
+
+
+def test_performance_event_emit_start():
+    from fluidframework_tpu.utils.telemetry import (
+        MockLogger,
+        PerformanceEvent,
+    )
+
+    logger = MockLogger()
+    with PerformanceEvent(logger, "span", emit_start=True, doc="d"):
+        assert logger.events[0]["eventName"] == "span_start"
+        assert logger.events[0]["category"] == "performance"
+        assert logger.events[0]["doc"] == "d"
+    assert logger.events[-1]["eventName"] == "span_end"
+    # default stays start-silent
+    logger2 = MockLogger()
+    with PerformanceEvent(logger2, "quiet"):
+        assert logger2.events == []
+
+
+def test_lumber_double_emit_is_loud_error_event_not_crash():
+    from fluidframework_tpu.service.telemetry import (
+        InMemoryLumberjackEngine,
+        Lumberjack,
+    )
+
+    engine = InMemoryLumberjackEngine()
+    jack = Lumberjack(engines=[engine])
+    lumber = jack.new_metric("op", {"documentId": "d"})
+    lumber.success("first")
+    before = obs_metrics.REGISTRY.flat().get(
+        "telemetry_lumber_double_emit_total", 0.0)
+    lumber.error("second")  # must NOT raise, must NOT re-emit "op"
+    assert len(engine.events_named("op")) == 1
+    dups = engine.events_named("op:doubleEmit")
+    assert len(dups) == 1
+    assert dups[0].successful is False
+    assert dups[0].properties["firstOutcome"] is True
+    assert "completed twice" in dups[0].message
+    after = obs_metrics.REGISTRY.flat()[
+        "telemetry_lumber_double_emit_total"]
+    assert after == before + 1
+
+
+# ======================================================================
+# per-op latency ledger
+
+
+def test_op_latency_ledger_bounded_and_formats():
+    from fluidframework_tpu.runtime.op_lifecycle import OpLatencyLedger
+
+    ledger = OpLatencyLedger(capacity=3)
+    for csn in range(1, 6):
+        traces = stamp([], "client", "submit", timestamp=float(csn))
+        stamp(traces, "client", "ack", timestamp=csn + 0.25)
+        ledger.record(csn, csn + 100, traces)
+    assert len(ledger) == 3
+    assert ledger.get(1) is None  # evicted
+    newest = ledger.get()
+    assert newest["clientSequenceNumber"] == 5
+    assert newest["total_ms"] == pytest.approx(250.0)
+    text = ledger.format(4)
+    assert "csn=4" in text and "client:ack" in text
+    summary = ledger.summary()
+    assert summary["client:ack"]["count"] == 3
+    assert summary["client:ack"]["mean_ms"] == pytest.approx(250.0)
+    assert ledger.format(99) == "(no acked op recorded)"
+
+
+def test_container_ledger_end_to_end_in_proc():
+    from fluidframework_tpu.drivers.local_driver import (
+        LocalDocumentServiceFactory,
+    )
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.service.local_server import LocalServer
+
+    server = LocalServer()
+    svc = LocalDocumentServiceFactory(server).create_document_service(
+        "obs-doc")
+    c = Container.load(svc, client_id="alice")
+    s = c.runtime.create_datastore("app").create_channel(
+        "sharedstring", "t")
+    s.insert_text(0, "hello")
+    c.flush()
+    entry = c.op_trace()
+    assert entry is not None
+    hops = [h["hop"] for h in entry["hops"]]
+    # the in-proc path: submit, driver-send, ticket, oplog, scribe,
+    # fanout, ack — in this order
+    assert hops == [
+        "client:submit", "driver:send", "sequencer:ticket",
+        "scriptorium:write", "scribe:process", "broadcaster:fanout",
+        "client:ack",
+    ]
+    assert "client:submit" in c.op_breakdown()
+    c.close()
+
+
+# ======================================================================
+# sidecar pillar: flight recorder + opt-in trace hops
+
+
+def test_sidecar_records_rounds_and_stamps_pack_settle():
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+    from fluidframework_tpu.service.tpu_sidecar import TpuMergeSidecar
+
+    sc = TpuMergeSidecar(max_docs=4, capacity=128, trace_ops=True)
+    sc.track("d", "ds", "ch")
+    msg = SequencedMessage(
+        client_id="c1", sequence_number=1,
+        minimum_sequence_number=0, client_sequence_number=1,
+        reference_sequence_number=0, type=MessageType.OPERATION,
+        contents={"kind": "op", "address": "ds", "channel": "ch",
+                  "contents": {"__mergeop__": None}},
+    )
+    # a real text op through the normal encode path
+    from fluidframework_tpu.models.mergetree.ops import InsertOp
+
+    msg.contents["contents"] = InsertOp(pos1=0, text="hi")
+    sc.ingest("d", msg)
+    assert sc.apply() == 1
+    sc.sync()
+    hops = {(t.service, t.action) for t in msg.traces}
+    assert ("sidecar", "pack") in hops
+    assert ("sidecar", "settle") in hops
+    assert msg in sc.last_settled_msgs
+    kinds = [e[2] for e in sc.flight.events()]
+    assert "dispatch" in kinds and "settle" in kinds
+    # settle events carry the pre-fetched overflow bool
+    settle = next(e for e in sc.flight.events() if e[2] == "settle")
+    assert settle[3]["overflow"] is False
+
+
+def test_sidecar_trace_ops_default_off():
+    from fluidframework_tpu.service.tpu_sidecar import TpuMergeSidecar
+
+    assert TpuMergeSidecar(max_docs=2, capacity=64).trace_ops is False
+
+
+def test_sidecar_overflow_recovery_dumps_flight_recorder(capsys):
+    from fluidframework_tpu.drivers.local_driver import (
+        LocalDocumentServiceFactory,
+    )
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.service import LocalServer, TpuMergeSidecar
+
+    server = LocalServer()
+    sc = TpuMergeSidecar(max_docs=2, capacity=16, max_capacity=512)
+    sc.subscribe(server, "doc", "d", "s")
+    factory = LocalDocumentServiceFactory(server)
+    c = Container.load(factory.create_document_service("doc"),
+                       client_id="writer")
+    s = c.runtime.create_datastore("d").create_channel(
+        "sharedstring", "s")
+    for _ in range(40):
+        s.insert_text(0, "abcdefgh")
+        c.flush()
+    sc.apply()
+    sc.sync()  # pipelined: recovery runs at settle
+    assert sc.grow_count >= 1
+    assert sc.last_flight_dump is not None
+    assert "overflow flag set" in sc.last_flight_dump
+    assert "dispatch" in sc.last_flight_dump
+    captured = capsys.readouterr()
+    assert "flight-recorder[sidecar]" in captured.err
+    c.close()
+
+
+# ======================================================================
+# ingress metrics plane
+
+
+def test_ingress_metrics_frame_and_dump_cli(alfred):
+    import socket as socket_mod
+
+    from fluidframework_tpu.service.__main__ import dump_metrics
+    from fluidframework_tpu.service.ingress import (
+        pack_frame,
+        recv_frame_blocking,
+    )
+
+    server = alfred()
+    with socket_mod.create_connection(
+            ("127.0.0.1", server.port), timeout=10) as sock:
+        sock.sendall(pack_frame({"type": "metrics", "rid": 7}))
+        frame = recv_frame_blocking(sock)
+    assert frame["type"] == "metrics" and frame["rid"] == 7
+    assert "# TYPE sequencer_tickets_total counter" in frame["text"]
+    assert "sequencer_tickets_total" in frame["metrics"]
+    # the CLI command against the same server
+    assert dump_metrics(f"127.0.0.1:{server.port}", False) == 0
+    assert dump_metrics(f"127.0.0.1:{server.port}", True) == 0

@@ -160,12 +160,19 @@ def test_lumberjack_error_with_exception():
     assert "disk" in lumber.properties["exception"]
 
 
-def test_lumber_double_emit_asserts():
+def test_lumber_double_emit_is_recorded_not_a_crash():
+    # the old `assert not self._emitted` guard vanished under
+    # `python -O` (silent double emit) and crashed the service path
+    # otherwise; a double-completion is now a LOUD recorded error
+    # event — the first emission stands, the duplicate is evidence
     engine = InMemoryLumberjackEngine()
     metric = Lumberjack([engine]).new_metric("m")
     metric.success()
-    with pytest.raises(AssertionError):
-        metric.success()
+    metric.success()  # no raise
+    assert len(engine.events_named("m")) == 1
+    (dup,) = engine.events_named("m:doubleEmit")
+    assert dup.successful is False
+    assert "completed twice" in dup.message
 
 
 # ----------------------------------------------------------------------

@@ -184,6 +184,103 @@ def test_unnegotiated_connection_cannot_use_upload_frames(alfred):
         svc.close()
 
 
+def test_boxcar_carries_traces_intact_roundtrip(alfred):
+    """A wire-1.2 boxcar frame carries each member op's traces; the
+    sequenced broadcasts and the op-log reads both return them
+    decoded intact, with the service hops appended in order."""
+    server = alfred()
+    svc, c = _load(server.port, "tr", "alice")
+    try:
+        assert svc.agreed_version == "1.2"
+        with svc.lock:
+            t = c.runtime.create_datastore("ds").create_channel(
+                "sharedstring", "t")
+            for i in range(3):
+                t.insert_text(0, f"x{i}")
+            c.flush()  # one 3-op boxcar
+        assert _pump(svc, c)
+        with svc.lock:
+            msgs = [m for m in svc.read_ops(0)
+                    if m.client_id == "alice"]
+        ops = [m for m in msgs if m.traces]
+        assert ops, "no traced ops came back from delta storage"
+        for m in ops[-3:]:
+            hops = [(tr.service, tr.action) for tr in m.traces]
+            # client-side stamps survived the wire, service stamps
+            # appended after them
+            assert hops[0] == ("client", "submit")
+            assert ("driver", "send") in hops
+            assert ("ingress", "receive") in hops
+            assert ("sequencer", "ticket") in hops
+            assert hops.index(("client", "submit")) < hops.index(
+                ("sequencer", "ticket"))
+            # timestamps are real floats, monotone within one process
+            stamps = [tr.timestamp for tr in m.traces]
+            assert stamps == sorted(stamps)
+        # the ledgered ack-side view agrees (per-op breakdown)
+        with svc.lock:
+            entry = c.op_trace()
+        assert entry is not None
+        assert [h["hop"] for h in entry["hops"]][0] == "client:submit"
+        assert "client:ack" in [h["hop"] for h in entry["hops"]]
+        with svc.lock:
+            c.close()
+    finally:
+        svc.close()
+
+
+def test_traces_optional_on_wire_10_peer_interops(alfred):
+    """Traces are optional on the wire: a 1.0 peer (per-op frames, no
+    boxcar) still interoperates, and frames WITHOUT a traces key
+    decode to an empty list — the pre-tracing format stays valid."""
+    from fluidframework_tpu.protocol.serialization import (
+        message_from_json,
+        message_to_json,
+    )
+    from fluidframework_tpu.service.ingress import (
+        document_message_from_json,
+    )
+
+    # decoder side: omitted traces = empty, never a KeyError
+    legacy_op = {
+        "client_sequence_number": 1,
+        "reference_sequence_number": 0,
+        "type": 2, "contents": None, "metadata": None,
+    }
+    assert document_message_from_json(legacy_op).traces == []
+    legacy_seq = {
+        "clientId": "a", "sequenceNumber": 1,
+        "minimumSequenceNumber": 0, "clientSequenceNumber": 1,
+        "referenceSequenceNumber": 0, "type": 2, "contents": None,
+    }
+    decoded = message_from_json(legacy_seq)
+    assert decoded.traces == []
+    # and an untraced message serializes WITHOUT the key (recorded
+    # corpora stay byte-stable)
+    assert "traces" not in message_to_json(decoded)
+
+    # live 1.0 pairing over TCP: per-op frames, traces still flow
+    # (they are plain op-frame fields, present since wire 1.0)
+    server = alfred()
+    svc, c = _load(server.port, "old", "alice", versions=("1.0",))
+    try:
+        assert svc.agreed_version == "1.0"
+        with svc.lock:
+            t = c.runtime.create_datastore("ds").create_channel(
+                "sharedstring", "t")
+            t.insert_text(0, "legacy")
+            c.flush()
+        assert _pump(svc, c)
+        with svc.lock:
+            assert t.get_text() == "legacy"
+            entry = c.op_trace()
+        assert entry is not None  # ack-side breakdown works on 1.0 too
+        with svc.lock:
+            c.close()
+    finally:
+        svc.close()
+
+
 def test_negotiated_10_connection_cannot_use_upload_frames(alfred):
     """Server-side enforcement: a connection that AGREED 1.0 gets a
     loud error for 1.1 frames (not a silent accept)."""
