@@ -26,7 +26,8 @@ from .core import (
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m fluidframework_tpu.analysis",
-        description="fluidlint: layercheck + jaxhazards + lockcheck",
+        description="fluidlint: layercheck + jaxhazards + lockcheck "
+                    "+ obscheck + qoscheck",
     )
     parser.add_argument(
         "paths", nargs="*", default=None,

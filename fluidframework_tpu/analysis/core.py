@@ -158,7 +158,8 @@ def load_allowlist(path: str = ALLOWLIST_PATH) -> list[tuple[str, str]]:
     return entries
 
 
-FAMILIES = ("layercheck", "jaxhazards", "lockcheck", "obscheck")
+FAMILIES = ("layercheck", "jaxhazards", "lockcheck", "obscheck",
+            "qoscheck")
 
 
 def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
@@ -168,13 +169,14 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
     """Run the selected pass families; returns findings with per-line
     suppressions already applied (allowlist filtering is the caller's
     choice — the CLI and gate apply it, tooling may want raw)."""
-    from . import jaxhazards, layercheck, lockcheck, obscheck
+    from . import jaxhazards, layercheck, lockcheck, obscheck, qoscheck
 
     passes = {
         "layercheck": layercheck.check,
         "jaxhazards": jaxhazards.check,
         "lockcheck": lockcheck.check,
         "obscheck": obscheck.check,
+        "qoscheck": qoscheck.check,
     }
     unknown = [f for f in families if f not in passes]
     if unknown:

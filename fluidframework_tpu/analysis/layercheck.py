@@ -36,6 +36,11 @@ ALLOWED = {
     # stamps, metrics, flight recorders), and obs itself depends only
     # on the wire Trace type + utils — never on what it observes
     "obs": {"protocol", "utils"},
+    # qos sits beside obs: admission control / backpressure / circuit
+    # breaking used BY the service plane (and the tools that drive
+    # overload), depending only on obs metrics + protocol vocabulary
+    # — never on what it protects
+    "qos": {"obs", "protocol", "utils"},
     "models": {"protocol", "utils", "runtime"},  # runtime: the
     # SharedObject contract lives in runtime/shared_object (layer 6
     # sits on the datastore runtime, sharedObject.ts:42)
@@ -48,13 +53,14 @@ ALLOWED = {
                "utils"},
     "framework": {"drivers", "loader", "models", "runtime",
                   "service", "utils"},
-    "service": {"models", "native", "obs", "ops", "protocol", "utils"},
+    "service": {"models", "native", "obs", "ops", "protocol", "qos",
+                "utils"},
     "native": {"ops", "protocol", "service", "utils"},
     "parallel": {"ops", "utils"},
-    "testing": {"models", "obs", "ops", "protocol", "runtime",
+    "testing": {"models", "obs", "ops", "protocol", "qos", "runtime",
                 "service", "utils"},
     "tools": {"drivers", "loader", "models", "obs", "ops", "protocol",
-              "runtime", "service", "testing", "utils"},
+              "qos", "runtime", "service", "testing", "utils"},
 }
 
 # the two sanctioned mutual pairs; excluded from the acyclicity check

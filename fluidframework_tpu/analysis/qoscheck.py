@@ -1,0 +1,184 @@
+"""qoscheck — overload-safety rules for the service plane.
+
+``service-unbounded-queue``: an ``asyncio.Queue()`` without a
+``maxsize`` (or a ``collections.deque()`` without a ``maxlen``)
+reachable in the service layer is a standing invitation for one slow
+consumer or one hot tenant to buffer the server into the ground —
+exactly the failure the qos subsystem exists to rule out (the
+per-session outbound queue was this bug until it grew a bound and a
+slow-consumer policy; docs/QOS.md). The rule flags every unbounded
+construction in a ``service``/``qos`` path component; the few
+intentional ones (queues drained synchronously before the
+constructor's caller returns) carry a justified inline
+``# fluidlint: disable=service-unbounded-queue``.
+
+Scope is by PATH COMPONENT (any ``service`` or ``qos`` directory in
+the file's repo-relative path), so the rule covers the real tree and
+still fires on test fixtures placed under a ``service/`` tmp dir.
+``queue.Queue()`` (the threading one) counts too — the driver layer
+uses it legitimately, but in the service plane it has the same
+unbounded-buffer failure mode.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Finding, SourceFile
+
+# dotted-path suffixes that construct a queue-like container, and the
+# keyword (or positional index) that bounds it
+_QUEUE_SUFFIXES = {
+    "asyncio.Queue": ("maxsize", 0),
+    "asyncio.LifoQueue": ("maxsize", 0),
+    "asyncio.PriorityQueue": ("maxsize", 0),
+    "queue.Queue": ("maxsize", 0),
+    "queue.LifoQueue": ("maxsize", 0),
+    "queue.PriorityQueue": ("maxsize", 0),
+    "collections.deque": ("maxlen", 1),
+    "deque": ("maxlen", 1),
+}
+
+
+def _in_scope(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return "service" in parts[:-1] or "qos" in parts[:-1]
+
+
+def _import_aliases(tree: ast.AST) -> dict[str, str]:
+    """local name -> dotted origin (same resolution style as
+    obscheck: imports give the dotted path suffix matching keys on)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = (
+                    f"{node.module}.{a.name}"
+                )
+    return aliases
+
+
+def _dotted(node: ast.AST, aliases: dict[str, str]) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+def _bound_spec(dotted: str) -> Optional[tuple[str, int]]:
+    for suffix, spec in _QUEUE_SUFFIXES.items():
+        if dotted == suffix or dotted.endswith("." + suffix):
+            # the bare-name form ("deque") only counts when it came
+            # through an import (collections.deque resolves dotted);
+            # a module's own class named deque would resolve bare and
+            # must not fire — mirrored from obscheck's reasoning
+            if suffix == "deque" and dotted == "deque":
+                return None
+            return spec
+    return None
+
+
+def _has_bound(node: ast.Call, spec: tuple[str, int]) -> bool:
+    kw_name, pos_index = spec
+
+    def bounds(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Constant):
+            return True  # a computed bound: trust it
+        if value.value is None:
+            return False  # explicit None = unbounded
+        # asyncio/queue semantics: maxsize <= 0 means INFINITE;
+        # deque(maxlen=0) genuinely bounds (to empty)
+        if kw_name == "maxsize" and isinstance(
+                value.value, (int, float)) and value.value <= 0:
+            return False
+        return True
+
+    for kw in node.keywords:
+        if kw.arg == kw_name:
+            return bounds(kw.value)
+        if kw.arg is None:
+            return True  # **kwargs: cannot prove unbounded
+    if len(node.args) > pos_index:
+        return bounds(node.args[pos_index])
+    return False
+
+
+def _qualname_of(stack: list[str], node: ast.Call,
+                 parents: dict) -> str:
+    """Stable, line-free finding key: enclosing scope + assignment
+    target (e.g. ``_ClientSession.__init__.outbound``)."""
+    target = ""
+    parent = parents.get(node)
+    # walk up through subscripts/annotations to the binding statement
+    hops = 0
+    while parent is not None and hops < 4:
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            t = parent.targets[0] if isinstance(parent, ast.Assign) \
+                else parent.target
+            if isinstance(t, ast.Attribute):
+                target = t.attr
+            elif isinstance(t, ast.Name):
+                target = t.id
+            break
+        parent = parents.get(parent)
+        hops += 1
+    scope = ".".join(stack) or "<module>"
+    return f"{scope}.{target}" if target else scope
+
+
+def check(files: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in files:
+        if src.tree is None or not _in_scope(src.relpath):
+            continue
+        aliases = _import_aliases(src.tree)
+        module = src.relpath.rsplit("/", 1)[-1]
+        parents: dict = {}
+        for parent in ast.walk(src.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+
+        def scope_stack(node) -> list[str]:
+            out: list[str] = []
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                    out.append(cur.name)
+                cur = parents.get(cur)
+            return list(reversed(out))
+
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func, aliases)
+            if dotted is None:
+                continue
+            spec = _bound_spec(dotted)
+            if spec is None or _has_bound(node, spec):
+                continue
+            qual = _qualname_of(scope_stack(node), node, parents)
+            findings.append(Finding(
+                rule="service-unbounded-queue",
+                path=src.relpath, line=node.lineno,
+                message=(
+                    f"unbounded {dotted}() in the service layer: one "
+                    "slow consumer / hot tenant buffers the server "
+                    "into the ground — pass a bound "
+                    f"({spec[0]}=...) and an explicit overflow "
+                    "policy, or justify with '# fluidlint: "
+                    "disable=service-unbounded-queue' (docs/QOS.md)"
+                ),
+                key=f"{module}:{qual}",
+            ))
+    return findings

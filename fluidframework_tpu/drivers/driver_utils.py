@@ -9,10 +9,15 @@ runtime/op_lifecycle.py).
 """
 from __future__ import annotations
 
+import random
 import time
 from typing import Any, Callable, Optional, TypeVar
 
 T = TypeVar("T")
+
+# module-level source for callers that don't inject their own; tests
+# and the loader pass a seeded random.Random for determinism
+_RNG = random.Random()
 
 
 class RetriableError(Exception):
@@ -25,6 +30,25 @@ class RetriableError(Exception):
         self.retry_after_seconds = retry_after_seconds
 
 
+def full_jitter_delay(attempt: int, *,
+                      base_delay_s: float = 0.05,
+                      max_delay_s: float = 5.0,
+                      floor_s: float = 0.0,
+                      rng: Optional[random.Random] = None) -> float:
+    """AWS-style FULL-JITTER backoff: uniform in [0, min(cap,
+    base*2^(attempt-1))], on TOP of ``floor_s``.
+
+    ``floor_s`` carries a service throttle's ``retry_after_seconds``
+    and is a FLOOR, never reduced: the service computed when capacity
+    returns, and coming back earlier just re-sheds. The jitter rides
+    ABOVE it because a deterministic schedule synchronizes every
+    client the service throttled in the same window — they would all
+    return at floor+base, floor+2*base, ... in lockstep, re-creating
+    the spike the throttle shed (the thundering herd)."""
+    span = min(max_delay_s, base_delay_s * (2 ** max(0, attempt - 1)))
+    return max(0.0, floor_s) + (rng or _RNG).uniform(0.0, span)
+
+
 def run_with_retry(fn: Callable[[], T], *,
                    max_retries: int = 5,
                    base_delay_s: float = 0.05,
@@ -33,10 +57,12 @@ def run_with_retry(fn: Callable[[], T], *,
                               TimeoutError),
                    sleep: Callable[[float], None] = time.sleep,
                    on_retry: Optional[Callable[[int, Exception], None]]
-                   = None) -> T:
+                   = None,
+                   rng: Optional[random.Random] = None) -> T:
     """driver-utils runWithRetry: call ``fn`` until it succeeds or a
-    non-retriable error/exhaustion; exponential backoff, honoring a
-    throttler's retry_after_seconds when present."""
+    non-retriable error/exhaustion; full-jitter exponential backoff
+    (:func:`full_jitter_delay`) with a throttler's
+    ``retry_after_seconds`` as the floor."""
     attempt = 0
     while True:
         try:
@@ -45,10 +71,13 @@ def run_with_retry(fn: Callable[[], T], *,
             attempt += 1
             if attempt > max_retries:
                 raise
-            delay = min(max_delay_s, base_delay_s * (2 ** (attempt - 1)))
             hinted = getattr(e, "retry_after_seconds", None)
-            if hinted is not None:
-                delay = max(delay, hinted)
+            delay = full_jitter_delay(
+                attempt, base_delay_s=base_delay_s,
+                max_delay_s=max_delay_s,
+                floor_s=hinted if hinted is not None else 0.0,
+                rng=rng,
+            )
             if on_retry is not None:
                 on_retry(attempt, e)
             sleep(delay)

@@ -303,6 +303,10 @@ class SocketDocumentService:
                 error_type=NackErrorType(frame["error_type"]),
                 message=frame.get("message", ""),
                 retry_after_seconds=frame.get("retry_after_seconds"),
+                # qos shed attribution: OPTIONAL on the wire (absent
+                # from pre-qos servers — test_wire_compat)
+                pressure_tier=frame.get("pressure_tier"),
+                shed_class=frame.get("shed_class"),
             ))
 
     def _request(self, data: dict) -> dict:
@@ -336,6 +340,17 @@ class SocketDocumentService:
             msg = frame.get("message", "server error")
             if frame.get("error_kind") == "permission":
                 raise PermissionError(msg)
+            if frame.get("error_kind") == "throttle":
+                # qos shed a storage-plane request: surface it as the
+                # RETRIABLE shape run_with_retry honors, with the
+                # server's honest retry hint as the backoff floor
+                from .driver_utils import RetriableError
+
+                raise RetriableError(
+                    msg,
+                    retry_after_seconds=frame.get(
+                        "retry_after_seconds"),
+                )
             raise RuntimeError(msg)
         return frame
 

@@ -15,10 +15,12 @@ ContainerRuntime, and stamps outbound ops with csn/refSeq.
 from __future__ import annotations
 
 import dataclasses
+import random
 import time
 from typing import Any, Optional
 
 from ..drivers.definitions import DocumentService
+from ..drivers.driver_utils import full_jitter_delay
 from ..models import default_registry
 from ..obs import metrics as obs_metrics
 from ..obs import register_closeable
@@ -28,6 +30,7 @@ from ..protocol.messages import (
     DocumentMessage,
     MessageType,
     Nack,
+    NackErrorType,
     SequencedMessage,
 )
 from ..protocol.quorum import ProtocolOpHandler
@@ -46,6 +49,9 @@ _NACKS_SEEN = obs_metrics.REGISTRY.counter(
     "container_nacks_total", "nacks containers received")
 _ROUNDTRIP_MS = obs_metrics.REGISTRY.histogram(
     "container_op_roundtrip_ms", "submit→ack wall latency per own op")
+_THROTTLE_DEFERRALS = obs_metrics.REGISTRY.counter(
+    "container_throttle_deferrals_total",
+    "flushes that deferred reconnect/resubmit under a throttle nack")
 
 
 class Container(EventEmitter):
@@ -103,6 +109,15 @@ class Container(EventEmitter):
         self.inbound_paused = False
         self._enqueued_seq = 0
         self._reconnect_on_nack = False
+        # throttle-nack backoff (the client half of the qos
+        # contract): a THROTTLING nack defers the reconnect/resubmit
+        # until retry_after_seconds + full jitter has passed, with
+        # consecutive throttles escalating the jitter span.
+        # Injectable clock/rng so tests pin the schedule exactly.
+        self._throttled_until = 0.0
+        self._throttle_strikes = 0
+        self._backoff_clock = time.monotonic
+        self._backoff_rng = random.Random()
         # msn heartbeats for idle clients (collabWindowTracker.ts);
         # noopCountFrequency=0 disables count-based heartbeats
         noop_every = self.mc.config.get_number("noopCountFrequency")
@@ -360,6 +375,9 @@ class Container(EventEmitter):
                     self._op_latency.record(roundtrip_ms)
                     _ROUNDTRIP_MS.observe(roundtrip_ms)
                     _OPS_ACKED.inc()
+                    # an acked op = the service is admitting us again:
+                    # the throttle-escalation streak resets
+                    self._throttle_strikes = 0
                     # the terminal hop: our own IN-FLIGHT op came back
                     # sequenced — close the trace and ledger the full
                     # breakdown. Guarded by `sent` on purpose: replays
@@ -418,14 +436,45 @@ class Container(EventEmitter):
         reference reconnects and replays pending state
         (connectionManager.ts nack handling); we tear the connection
         down immediately (safe mid-submit: later submits of the same
-        flush stay pending) and reconnect at the next flush."""
+        flush stay pending) and reconnect at the next flush.
+
+        A THROTTLING nack additionally arms a backoff deadline:
+        ``retry_after_seconds`` is the floor (the service computed
+        when capacity returns) plus full jitter escalating with
+        consecutive throttles — reconnecting the moment the window
+        expires, in lockstep with every other throttled client, would
+        re-create the spike the service just shed."""
         _NACKS_SEEN.inc()
+        if (
+            nack.error_type == NackErrorType.THROTTLING
+            and (nack.retry_after_seconds or 0.0) > 0.0
+        ):
+            # a POSITIVE retry hint = a qos admission shed; a bare
+            # throttle nack (legacy servers, injected faults) keeps
+            # the immediate reconnect-on-flush behavior
+            self._throttle_strikes += 1
+            delay = full_jitter_delay(
+                self._throttle_strikes,
+                base_delay_s=0.05, max_delay_s=5.0,
+                floor_s=nack.retry_after_seconds,
+                rng=self._backoff_rng,
+            )
+            self._throttled_until = max(
+                self._throttled_until,
+                self._backoff_clock() + delay,
+            )
+            self.emit("throttled", nack)
         self.emit("nack", nack)
         self.mc.logger.send_error_event(
             "nack", clientId=self.client_id, reason=nack.message,
         )
         self.disconnect()
         self._reconnect_on_nack = True  # after: disconnect clears it
+
+    @property
+    def throttled(self) -> bool:
+        """Still inside a throttle-nack backoff window?"""
+        return self._backoff_clock() < self._throttled_until
 
     # ------------------------------------------------------------------
     # outbound (DeltaManager.submit :213)
@@ -464,9 +513,16 @@ class Container(EventEmitter):
 
     def flush(self) -> None:
         if self._reconnect_on_nack and not self.closed:
-            self._reconnect_on_nack = False
-            if not self.connected:
-                self.connect()  # replays pending ops with fresh csn
+            if self.throttled:
+                # inside the throttle window: edits keep accumulating
+                # as pending local state; the reconnect (and with it
+                # the pending-op resubmit) waits out the deadline
+                # instead of hammering the service
+                _THROTTLE_DEFERRALS.inc()
+            else:
+                self._reconnect_on_nack = False
+                if not self.connected:
+                    self.connect()  # replays pending ops, fresh csn
         self.runtime.flush()
 
     # ------------------------------------------------------------------

@@ -88,13 +88,22 @@ class SequencedMessage:
 
 @dataclass
 class Nack:
-    """Service rejection of a raw op (INack)."""
+    """Service rejection of a raw op (INack).
+
+    ``retry_after_seconds`` mirrors the reference's throttling
+    retryAfter. ``pressure_tier`` and ``shed_class`` are the qos
+    subsystem's load-shed attribution (qos/policy.py) — OPTIONAL on
+    the wire: serialization emits them only when set, and 1.0/1.1
+    peers that omit or ignore them interoperate
+    (tests/test_wire_compat.py)."""
 
     operation: DocumentMessage | None
     sequence_number: int
     error_type: NackErrorType
     message: str = ""
     retry_after_seconds: float | None = None
+    pressure_tier: int | None = None
+    shed_class: str | None = None
 
 
 @dataclass

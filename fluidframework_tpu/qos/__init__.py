@@ -1,0 +1,86 @@
+"""Quality of service: admission control, backpressure, circuit
+breaking — the layer that keeps the ordering service live at 10x
+offered load.
+
+Reference: Routerlicious's per-tenant throttling middleware (alfred
+consults a Throttler before deli sees an op; throttle responses carry
+retryAfter, which drivers/driver_utils.py already honors client-side)
+plus the standard overload-control trio:
+
+- **Token-bucket rate limiters** (:mod:`.rate_limiter`) — per-tenant
+  / per-document / per-connection budgets for ops, bytes, summary
+  uploads and catch-up reads;
+- **Composite pressure signal** (:mod:`.pressure`) — queue depths
+  from across the pipeline (sequencer inbox, sidecar dispatch
+  backlog, broker fanout lag, session outbound queues) normalized
+  into one tier;
+- **Shed policy + admission gate** (:mod:`.policy`,
+  :mod:`.admission`) — pressure tier x traffic class -> admit or
+  shed with an HONEST ``retry_after_seconds``;
+- **Circuit breaker** (:mod:`.breaker`) — closed/open/half-open with
+  probe admission around sidecar dispatch and storage writes.
+
+Layering: qos sits beside obs (above protocol); the service plane
+imports it, it imports nothing it protects. Everything is clock-
+injectable so overload behavior pins down in deterministic tests
+(tests/test_qos.py) instead of timing races.
+"""
+from __future__ import annotations
+
+from .admission import AdmissionController, RateLimits, default_limits
+from .breaker import (
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    BreakerOpenError,
+    CircuitBreaker,
+)
+from .policy import (
+    CLASS_CATCHUP,
+    CLASS_SUMMARY,
+    CLASS_WRITE,
+    REASON_PRESSURE,
+    REASON_RATE_LIMIT,
+    SHED_ORDER,
+    Admission,
+    ShedPolicy,
+)
+from .pressure import (
+    TIER_CRITICAL,
+    TIER_ELEVATED,
+    TIER_NAMES,
+    TIER_NOMINAL,
+    TIER_SEVERE,
+    PressureMonitor,
+    PressureReading,
+)
+from .rate_limiter import Budget, ScopedBuckets, TokenBucket
+
+__all__ = [
+    "Admission",
+    "AdmissionController",
+    "BreakerOpenError",
+    "Budget",
+    "CircuitBreaker",
+    "CLASS_CATCHUP",
+    "CLASS_SUMMARY",
+    "CLASS_WRITE",
+    "PressureMonitor",
+    "PressureReading",
+    "RateLimits",
+    "REASON_PRESSURE",
+    "REASON_RATE_LIMIT",
+    "ScopedBuckets",
+    "SHED_ORDER",
+    "ShedPolicy",
+    "STATE_CLOSED",
+    "STATE_HALF_OPEN",
+    "STATE_OPEN",
+    "TIER_CRITICAL",
+    "TIER_ELEVATED",
+    "TIER_NAMES",
+    "TIER_NOMINAL",
+    "TIER_SEVERE",
+    "TokenBucket",
+    "default_limits",
+]

@@ -64,6 +64,17 @@ def main() -> None:
                              "fluidframework_tpu.service.broker — the "
                              "networked ordering queue (partitions "
                              "span hosts)")
+    parser.add_argument("--qos", action="store_true",
+                        help="enable admission control + "
+                             "backpressure (docs/QOS.md): token-"
+                             "bucket rate limits, pressure-tier load "
+                             "shedding with honest retry-after "
+                             "throttle nacks, checkpoint circuit "
+                             "breaker")
+    parser.add_argument("--qos-ops-per-sec", type=float,
+                        default=2000.0,
+                        help="per-connection op budget the other "
+                             "qos limits scale from (default 2000)")
     parser.add_argument("--dump-metrics", default=None,
                         metavar="HOST:PORT",
                         help="print a RUNNING service's metrics "
@@ -76,7 +87,8 @@ def main() -> None:
     if args.dump_metrics is not None:
         raise SystemExit(dump_metrics(args.dump_metrics, args.json))
     run_server(args.host, args.port, args.data_dir, args.partitions,
-               args.broker)
+               args.broker, qos_enabled=args.qos,
+               qos_ops_per_sec=args.qos_ops_per_sec)
 
 
 if __name__ == "__main__":

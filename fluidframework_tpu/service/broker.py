@@ -51,6 +51,10 @@ _COMMITS = obs_metrics.REGISTRY.counter(
     "broker_commits_total", "consumer offset commits")
 _BROKER_ERRORS = obs_metrics.REGISTRY.counter(
     "broker_frame_errors_total", "broker frames that raised")
+_BROKER_LAG = obs_metrics.REGISTRY.gauge(
+    "broker_fanout_lag",
+    "produced-but-uncommitted records across partitions at last "
+    "sample (the qos backpressure signal)")
 
 
 class BrokerServer:
@@ -126,7 +130,8 @@ class BrokerServer:
     def _dispatch(self, frame: dict) -> dict:
         kind = frame.get("type")
         p = int(frame.get("partition", -1))
-        if not 0 <= p < self.n_partitions and kind != "meta":
+        if not 0 <= p < self.n_partitions and \
+                kind not in ("meta", "lag"):
             raise ValueError(f"partition {p} out of range")
         if kind == "produce":
             offset = self.queue.produce(
@@ -157,7 +162,18 @@ class BrokerServer:
         if kind == "meta":
             return {"type": "meta",
                     "n_partitions": self.n_partitions}
+        if kind == "lag":
+            # consumer-lag probe (the qos 'broker_fanout' pressure
+            # source): cheap server-side arithmetic, no log reads
+            lag = self.fanout_lag()
+            return {"type": "lag", "lag": lag}
         raise ValueError(f"unknown broker frame {kind!r}")
+
+    def fanout_lag(self) -> int:
+        """Produced-but-uncommitted records across all partitions."""
+        lag = self.queue.fanout_lag()
+        _BROKER_LAG.set(lag)
+        return lag
 
 
 class RemoteOrderingQueue(OrderingQueue):
@@ -265,6 +281,13 @@ class RemoteOrderingQueue(OrderingQueue):
             "type": "commit", "partition": partition,
             "offset": offset,
         })
+
+    # a BLOCKING round trip: tooling/off-loop samplers only — the
+    # ingress refuses to wire it as a serving-path pressure source
+    # (fanout_lag_is_local stays False; see OrderingQueue)
+    def fanout_lag(self) -> int:
+        """Broker-side consumer lag (one round trip)."""
+        return self._request({"type": "lag"})["lag"]
 
 
 def run_broker(host: str = "127.0.0.1", port: int = 7081,

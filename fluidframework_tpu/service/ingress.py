@@ -38,14 +38,17 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import itertools
 import json
 import struct
+import sys
 import time
 from typing import Any, Optional
 
 from ..obs import metrics as obs_metrics
 from ..obs.trace import stamp as trace_stamp
 from ..protocol.constants import wire_version_lt
+from ..qos import CLASS_CATCHUP, CLASS_SUMMARY, CLASS_WRITE
 from ..protocol.messages import (
     ClientDetail,
     DocumentMessage,
@@ -84,6 +87,18 @@ _NACKS_OUT = obs_metrics.REGISTRY.counter(
     "ingress_nacks_sent_total", "nack frames sent to clients")
 _ERRORS_OUT = obs_metrics.REGISTRY.counter(
     "ingress_errors_sent_total", "error frames sent to clients")
+_THROTTLE_NACKS = obs_metrics.REGISTRY.counter(
+    "ingress_throttle_nacks_total",
+    "frames refused by the qos admission gate", labelnames=("klass",))
+_OUT_DROPPED = obs_metrics.REGISTRY.counter(
+    "ingress_outbound_dropped_total",
+    "sequenced-op fanout frames dropped to slow consumers")
+_SLOW_DISCONNECTS = obs_metrics.REGISTRY.counter(
+    "ingress_slow_consumer_disconnects_total",
+    "sessions disconnected past the hard outbound limit")
+_OUT_DEPTH = obs_metrics.REGISTRY.gauge(
+    "ingress_outbound_depth_max",
+    "deepest per-session outbound queue at last sample")
 
 # Wire-protocol versions this server speaks (newest first). The
 # reference negotiates `versions` on connect_document
@@ -132,7 +147,7 @@ def document_message_from_json(data: dict) -> DocumentMessage:
 
 
 def nack_to_json(nack: Nack) -> dict:
-    return {
+    out = {
         "sequence_number": nack.sequence_number,
         "error_type": int(nack.error_type),
         "message": nack.message,
@@ -140,21 +155,38 @@ def nack_to_json(nack: Nack) -> dict:
         "operation": document_message_to_json(nack.operation)
         if nack.operation is not None else None,
     }
+    # qos shed attribution is OPTIONAL on the wire: emitted only when
+    # set, so pre-qos nack frames stay byte-identical and 1.0/1.1
+    # peers never see keys they don't know (test_wire_compat)
+    if nack.pressure_tier is not None:
+        out["pressure_tier"] = nack.pressure_tier
+    if nack.shed_class is not None:
+        out["shed_class"] = nack.shed_class
+    return out
 
 
-async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+async def read_frame_sized(reader: asyncio.StreamReader
+                           ) -> tuple[Optional[dict], int]:
+    """(frame, wire bytes) — the server's read path keeps the exact
+    frame size so the qos byte budgets charge what the wire carried,
+    not a re-serialization estimate."""
     try:
         header = await reader.readexactly(_LEN.size)
     except (asyncio.IncompleteReadError, ConnectionResetError):
-        return None
+        return None, 0
     (length,) = _LEN.unpack(header)
     if length > MAX_FRAME:
         raise ValueError(f"frame too large: {length}")
     try:
         body = await reader.readexactly(length)
     except (asyncio.IncompleteReadError, ConnectionResetError):
-        return None
-    return json.loads(body.decode("utf-8"))
+        return None, 0
+    return json.loads(body.decode("utf-8")), length
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Optional[dict]:
+    frame, _ = await read_frame_sized(reader)
+    return frame
 
 
 def recv_frame_blocking(sock) -> dict:
@@ -191,11 +223,28 @@ class _ClientSession:
     documents (the reference multiplexes the same way per socket)."""
 
     def __init__(self, server: "AlfredServer",
-                 writer: asyncio.StreamWriter):
+                 writer: Optional[asyncio.StreamWriter]):
         self.server = server
         self.writer = writer
-        self.outbound: asyncio.Queue[Optional[bytes]] = asyncio.Queue()
+        self.session_id = f"sess-{next(server._session_counter)}"
+        # BOUNDED (maxsize = the hard slow-consumer limit): an
+        # undrained reader must cost a bounded number of buffered
+        # frames, never the server's memory. The drop/nack/disconnect
+        # policy lives in send() below.
+        self.outbound: asyncio.Queue[Optional[bytes]] = asyncio.Queue(
+            maxsize=server.max_outbound_depth
+        )
+        self.closed = False
+        # slow-consumer state: once the soft threshold is crossed,
+        # sequenced-op fanout frames DROP (the client's own gap
+        # refetch recovers them from delta storage) until the queue
+        # drains to half the threshold — hysteresis, so the
+        # drop-enter nack doesn't flap per frame
+        self.dropping = False
+        self.dropped_ops = 0
         self.connections: dict[str, DeltaConnection] = {}
+        # doc -> tenant_id seen at connect (qos bucket scope key)
+        self.tenant_ids: dict[str, str] = {}
         # documents this session has passed the token gate for (a
         # disconnect keeps the authorization; the token was validated)
         self.authorized: set[str] = set()
@@ -209,7 +258,64 @@ class _ClientSession:
         self.wire_versions: dict[str, str] = {}
 
     def send(self, data: dict) -> None:
-        self.outbound.put_nowait(pack_frame(data))
+        """Enqueue one outbound frame under the slow-consumer policy:
+
+        - sequenced-op fanout ("op") past the soft threshold DROPS
+          (with ONE throttle nack on entering the dropping state, so
+          the driver backs off); the client's inbound gap detection
+          refetches dropped ops from delta storage — fanout frames
+          are a delivery optimization, the op log is the truth;
+        - anything still overflowing the hard maxsize (request
+          replies, nacks — the session is hopeless by then) closes
+          the connection LOUDLY. A reader that never drains costs a
+          bounded queue, a counter and a disconnect; never the
+          server's memory.
+        """
+        if self.closed:
+            return
+        if data.get("type") == "op":
+            depth = self.outbound.qsize()
+            soft = self.server.outbound_drop_threshold
+            if self.dropping and depth <= soft // 2:
+                self.dropping = False
+            if self.dropping or depth >= soft:
+                entered = not self.dropping
+                self.dropping = True
+                self.dropped_ops += 1
+                _OUT_DROPPED.inc()
+                if entered:
+                    _NACKS_OUT.inc()
+                    self._put(pack_frame({
+                        "type": "nack",
+                        "document_id": data.get("document_id"),
+                        "operation": None,
+                        "sequence_number": 0,
+                        "error_type": int(NackErrorType.THROTTLING),
+                        "message": (
+                            "slow consumer: outbound queue at "
+                            f"{depth} frames; dropping sequenced-op "
+                            "fanout (refetch via read_ops)"
+                        ),
+                        "retry_after_seconds": 1.0,
+                    }))
+                return
+        self._put(pack_frame(data))
+
+    def _put(self, frame: bytes) -> None:
+        try:
+            self.outbound.put_nowait(frame)
+        except asyncio.QueueFull:
+            # hard limit: the consumer has not drained ANYTHING for
+            # maxsize frames — disconnect loudly (the counter + stderr
+            # line are the "loud"; reconnect is the client's recovery)
+            _SLOW_DISCONNECTS.inc()
+            print(
+                f"ingress[{self.session_id}]: outbound queue hit the "
+                f"hard limit ({self.server.max_outbound_depth}); "
+                "disconnecting slow consumer",
+                file=sys.stderr,
+            )
+            self.close()
 
     async def writer_loop(self) -> None:
         while True:
@@ -223,19 +329,45 @@ class _ClientSession:
                 break
 
     def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
         for conn in self.connections.values():
             conn.disconnect()
         self.connections.clear()
-        self.outbound.put_nowait(None)
+        try:
+            self.outbound.put_nowait(None)
+        except asyncio.QueueFull:
+            # full of undelivered frames: displace one so the writer
+            # pump still sees its shutdown sentinel
+            self.outbound.get_nowait()
+            self.outbound.put_nowait(None)
+        if self.writer is not None:
+            # actively tear the transport down: a hard-limit close
+            # must unblock the read loop too, not wait for the peer
+            try:
+                self.writer.close()
+            except (OSError, RuntimeError):
+                pass
 
 
 class AlfredServer:
     """asyncio ingress over a LocalServer (per-document LocalOrderer
     pipeline — deli/scriptorium/broadcaster/scribe equivalents)."""
 
+    # slow-consumer bounds (frames). Soft: sequenced-op fanout starts
+    # dropping (gap refetch recovers). Hard: the session disconnects.
+    MAX_OUTBOUND_DEPTH = 8192
+    OUTBOUND_DROP_THRESHOLD = 6144
+    # normalizing capacity for the sequencer-inbox pressure source
+    SEQUENCER_INBOX_CAPACITY = 1024
+
     def __init__(self, local: Optional[LocalServer] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 tenants: Optional[Any] = None):
+                 tenants: Optional[Any] = None,
+                 qos: Optional[Any] = None,
+                 max_outbound_depth: Optional[int] = None,
+                 outbound_drop_threshold: Optional[int] = None):
         self.local = local or LocalServer()
         self.host = host
         self.port = port
@@ -243,7 +375,69 @@ class AlfredServer:
         # when set, connect_document must carry tenant_id + a valid
         # signed claims token (alfred's verifyToken gate)
         self.tenants = tenants
+        # optional qos.AdmissionController: consulted BEFORE anything
+        # reaches the sequencer (submitOp), the storage planes
+        # (read_ops/fetch_summary) or the upload plane. None = the
+        # open dev-service shape, like tenants=None.
+        self.qos = qos
+        self.max_outbound_depth = (
+            max_outbound_depth or self.MAX_OUTBOUND_DEPTH
+        )
+        self.outbound_drop_threshold = min(
+            outbound_drop_threshold or self.OUTBOUND_DROP_THRESHOLD,
+            self.max_outbound_depth,
+        )
+        self._session_counter = itertools.count()
+        self._sessions: set[_ClientSession] = set()
         self._server: Optional[asyncio.base_events.Server] = None
+        if qos is not None and getattr(qos, "pressure", None) \
+                is not None:
+            self._register_pressure_sources(qos.pressure)
+
+    def _register_pressure_sources(self, pressure) -> None:
+        """Default composite-pressure wiring: the depths THIS process
+        can observe. ensure_source so operator/test-supplied sources
+        (e.g. a sidecar's queued_ops, a broker's fanout lag) are
+        never clobbered."""
+        # normalized against the HARD limit: the drop policy parks a
+        # persistently-slow consumer's queue at the soft threshold,
+        # which lands the ratio at soft/hard (elevated/severe by
+        # default) — sheds bulk traffic without starving writers;
+        # only a genuinely stalled event loop reaches critical
+        pressure.ensure_source(
+            "session_outbound", self._max_outbound_depth_now,
+            capacity=self.max_outbound_depth,
+        )
+        pressure.ensure_source(
+            "sequencer_inbox",
+            lambda: max(
+                (o.inbox_depth
+                 for o in getattr(self.local, "documents", {})
+                 .values()),
+                default=0,
+            ),
+            capacity=self.SEQUENCER_INBOX_CAPACITY,
+        )
+        # only LOCAL lag probes may sit on the serving path: the
+        # pressure monitor samples inside admit() on the event loop,
+        # and a RemoteOrderingQueue.fanout_lag is a blocking TCP
+        # round trip — a hung broker would turn the admission gate
+        # into the stall it exists to prevent. Remote lag belongs in
+        # an off-loop sampler feeding add_source with a cached value.
+        queue = getattr(self.local, "queue", None)
+        if queue is not None and getattr(
+                queue, "fanout_lag_is_local", False):
+            pressure.ensure_source(
+                "broker_fanout", queue.fanout_lag,
+                capacity=self.SEQUENCER_INBOX_CAPACITY,
+            )
+
+    def _max_outbound_depth_now(self) -> int:
+        depth = max(
+            (s.outbound.qsize() for s in self._sessions), default=0
+        )
+        _OUT_DEPTH.set(depth)
+        return depth
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -268,14 +462,15 @@ class AlfredServer:
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         session = _ClientSession(self, writer)
+        self._sessions.add(session)
         pump = asyncio.ensure_future(session.writer_loop())
         try:
             while True:
-                frame = await read_frame(reader)
-                if frame is None:
+                frame, nbytes = await read_frame_sized(reader)
+                if frame is None or session.closed:
                     break
                 try:
-                    self._dispatch(session, frame)
+                    self._dispatch(session, frame, nbytes)
                 except Exception as e:  # noqa: BLE001 - report, keep serving
                     _ERRORS_OUT.inc()
                     session.send({
@@ -290,6 +485,7 @@ class AlfredServer:
                         "message": f"{type(e).__name__}: {e}",
                     })
         finally:
+            self._sessions.discard(session)
             session.close()
             await pump
             writer.close()
@@ -350,7 +546,69 @@ class AlfredServer:
             "type": "nack", "document_id": doc, **nack_to_json(nack),
         })
 
-    def _dispatch(self, session: _ClientSession, frame: dict) -> None:
+    # -- qos admission gate --------------------------------------------
+
+    def _admit(self, session: _ClientSession, klass: str, doc: str,
+               frame: dict, ops: int = 1, nbytes: int = 0):
+        """Consult the admission controller (None when qos is off).
+        Returns the Admission, or None for 'admitted' fast-path."""
+        if self.qos is None:
+            return None
+        # tenant scope key: ONLY the connect-validated identity — a
+        # frame-supplied tenant_id is attacker-controlled (it would
+        # let one client charge a victim tenant's budget, or rotate
+        # fresh ids for an untouched bucket per frame). Pre-connect
+        # storage requests fall to the anonymous "" tenant and their
+        # per-connection budget.
+        adm = self.qos.admit(
+            klass,
+            tenant=session.tenant_ids.get(doc or "", ""),
+            document=doc or "",
+            connection=session.session_id,
+            ops=ops, nbytes=nbytes,
+        )
+        if adm.admitted:
+            return None
+        _THROTTLE_NACKS.labels(klass=klass).inc()
+        return adm
+
+    def _send_shed(self, session: _ClientSession, doc: str,
+                   frame: dict, adm, as_nack: bool) -> None:
+        """Tell the caller it was shed. Op-plane sheds go out as
+        throttle NACKs (the driver's on_nack path — the container
+        defers resubmit by retry_after_seconds); request/response
+        sheds answer the rid with a structured throttle error the
+        driver converts to a RetriableError."""
+        if as_nack:
+            self._send_nack(session, doc, Nack(
+                operation=None,
+                sequence_number=0,
+                error_type=NackErrorType.THROTTLING,
+                message=(
+                    f"admission refused ({adm.reason}): retry after "
+                    f"{adm.retry_after_seconds:.3f}s"
+                ),
+                retry_after_seconds=adm.retry_after_seconds,
+                pressure_tier=adm.tier,
+                shed_class=adm.shed_class,
+            ))
+            return
+        _ERRORS_OUT.inc()
+        session.send({
+            "type": "error",
+            "rid": frame.get("rid"),
+            "error_kind": "throttle",
+            "retry_after_seconds": adm.retry_after_seconds,
+            "pressure_tier": adm.tier,
+            "shed_class": adm.shed_class,
+            "message": (
+                f"throttled ({adm.reason}): retry after "
+                f"{adm.retry_after_seconds:.3f}s"
+            ),
+        })
+
+    def _dispatch(self, session: _ClientSession, frame: dict,
+                  nbytes: int = 0) -> None:
         kind = frame.get("type")
         doc = frame.get("document_id")
         _FRAMES.labels(
@@ -431,6 +689,7 @@ class AlfredServer:
             if mode == "write":
                 session.write_authorized.add(doc)
             session.wire_versions[doc] = agreed
+            session.tenant_ids[doc] = frame.get("tenant_id") or ""
             session.send({
                 "type": "connected", "document_id": doc,
                 "client_id": client_id, "version": agreed,
@@ -454,6 +713,25 @@ class AlfredServer:
             ops_json = boxcar if boxcar is not None else [frame["op"]]
             if boxcar is not None:
                 _BOXCARS.inc()
+            # the admission gate sits BEFORE decode: at 10x offered
+            # load, the shed path must cost a dict lookup and a
+            # bucket peek, not a full op decode. Summarize proposals
+            # classify as summary traffic (first to shed).
+            # ALL-summarize only: the client's summarizer submits
+            # solo frames, so this is the legit shape — a mixed batch
+            # must classify as write, or co-batching one SUMMARIZE
+            # would shed writer ops at ELEVATED and dodge the
+            # op/byte budgets (charging the summary buckets instead)
+            klass = CLASS_SUMMARY if ops_json and all(
+                o.get("type") == int(MessageType.SUMMARIZE)
+                for o in ops_json
+            ) else CLASS_WRITE
+            adm = self._admit(session, klass, doc, frame,
+                              ops=len(ops_json), nbytes=nbytes)
+            if adm is not None:
+                self._send_shed(session, doc, frame, adm,
+                                as_nack=True)
+                return
             # decode the WHOLE array before submitting anything: a
             # malformed op mid-boxcar must fail the batch as a unit
             # (error frame, nothing sequenced) — partially ticketing
@@ -481,6 +759,11 @@ class AlfredServer:
                         "message": str(e),
                     })
         elif kind == "read_ops":
+            adm = self._admit(session, CLASS_CATCHUP, doc, frame)
+            if adm is not None:
+                self._send_shed(session, doc, frame, adm,
+                                as_nack=False)
+                return
             self._check_read_access(session, doc, frame)
             msgs = self.local.read_ops(
                 doc, frame["from_seq"], frame.get("to_seq")
@@ -490,6 +773,11 @@ class AlfredServer:
                 "msgs": [message_to_json(m) for m in msgs],
             })
         elif kind == "fetch_summary":
+            adm = self._admit(session, CLASS_CATCHUP, doc, frame)
+            if adm is not None:
+                self._send_shed(session, doc, frame, adm,
+                                as_nack=False)
+                return
             self._check_read_access(session, doc, frame)
             latest = self.local.latest_summary(doc)
             payload: dict[str, Any] = {
@@ -521,6 +809,21 @@ class AlfredServer:
                     f"summary upload requires wire version >= 1.1 "
                     f"(connection agreed {agreed})"
                 )
+            # admission gates NEW uploads only (chunk 0), charged the
+            # whole upload's estimated bytes up front — shedding a
+            # continuation chunk would strand the staged prefix and
+            # surface as a misleading out-of-order error later (the
+            # same reasoning as the loud at-cap rejection below)
+            if int(frame.get("chunk", 0)) == 0:
+                est = len(str(frame.get("data", ""))) * max(
+                    1, int(frame.get("total", 1))
+                )
+                adm = self._admit(session, CLASS_SUMMARY, doc, frame,
+                                  ops=1, nbytes=est)
+                if adm is not None:
+                    self._send_shed(session, doc, frame, adm,
+                                    as_nack=False)
+                    return
             self._check_write_access(session, doc, frame)
             self._handle_upload_chunk(session, doc, frame)
         elif kind == "disconnect_document":
@@ -699,7 +1002,9 @@ def _check_durable_layout(data_dir: Optional[str],
 def run_server(host: str = "127.0.0.1", port: int = 7070,
                data_dir: Optional[str] = None,
                partitions: int = 0,
-               broker: Optional[str] = None) -> None:
+               broker: Optional[str] = None,
+               qos_enabled: bool = False,
+               qos_ops_per_sec: float = 2000.0) -> None:
     """Blocking entry point (the tinylicious analogue; see
     service/__main__.py). ``data_dir`` makes every document durable:
     op log, summaries and deli checkpoints survive restarts.
@@ -707,7 +1012,11 @@ def run_server(host: str = "127.0.0.1", port: int = 7070,
     queue pipeline (the kafka-deployment shape) instead of the inline
     orderer; ``broker`` = "host:port" of a running
     ``service.broker`` — the NETWORKED queue, so partitions span
-    processes/hosts (services-ordering-rdkafka's role)."""
+    processes/hosts (services-ordering-rdkafka's role).
+    ``qos_enabled`` turns on admission control + backpressure
+    (docs/QOS.md): token-bucket limits scaled from
+    ``qos_ops_per_sec``, pressure-tier shedding, and a circuit
+    breaker around checkpoint writes."""
     queue = None
     if broker is not None:
         from .broker import RemoteOrderingQueue
@@ -750,15 +1059,38 @@ def run_server(host: str = "127.0.0.1", port: int = 7070,
         data_dir, partitions,
         queue_source="broker" if broker else "local",
     )
+    qos = None
+    storage_breaker = None
+    if qos_enabled:
+        from ..qos import (
+            AdmissionController,
+            CircuitBreaker,
+            PressureMonitor,
+            default_limits,
+        )
+
+        if data_dir is not None:
+            storage_breaker = CircuitBreaker(
+                "checkpoint-storage", failure_threshold=3,
+                reset_timeout_s=5.0,
+            )
+        qos = AdmissionController(
+            limits=default_limits(qos_ops_per_sec),
+            # cost-bounded sampling on the serving path: at overload
+            # the gate runs per frame; 50ms staleness is immaterial
+            # against queue depths that build over seconds
+            pressure=PressureMonitor(min_interval_s=0.05),
+        )
     if partitions > 0:
         from .partitioning import PartitionedServer
 
         local = PartitionedServer(
             n_partitions=partitions, durable_dir=data_dir,
-            queue=queue)
+            queue=queue, storage_breaker=storage_breaker)
     else:
-        local = LocalServer(durable_dir=data_dir)
-    server = AlfredServer(local, host=host, port=port)
+        local = LocalServer(durable_dir=data_dir,
+                            storage_breaker=storage_breaker)
+    server = AlfredServer(local, host=host, port=port, qos=qos)
 
     async def main():
         await server.start()

@@ -32,13 +32,19 @@ class LocalOrderer:
     """One document's ordering service instance."""
 
     def __init__(self, document_id: str, lumberjack=None,
-                 storage=None, checkpoint_every: int = 1):
+                 storage=None, checkpoint_every: int = 1,
+                 storage_breaker=None):
         import os
 
         from .telemetry import Lumberjack
         self.document_id = document_id
         self.lumberjack = lumberjack or Lumberjack()
         self.storage = storage
+        # optional qos.CircuitBreaker around checkpoint writes: a
+        # hard-down disk degrades durability (the op log still has
+        # every op; restart fast-forwards from it) instead of taking
+        # the sequencing path down with it
+        self.storage_breaker = storage_breaker
         self.op_log = storage.op_log if storage is not None else OpLog()
         self.summary_store = SummaryStore(storage)
         self.sequencer = DocumentSequencer(document_id)
@@ -65,7 +71,7 @@ class LocalOrderer:
         # flatten re-entrancy with a pump: a submit made from inside a
         # delivery enqueues and is dispatched after the current message
         # finishes (LocalKafka's async delivery, memory-orderer).
-        self._dispatch_queue: deque[SequencedMessage] = deque()
+        self._dispatch_queue: deque[SequencedMessage] = deque()  # fluidlint: disable=service-unbounded-queue -- drained to empty inside _dispatch before control returns to the submitter; depth is bounded by re-entrant submits within ONE pump, not by client traffic
         self._dispatching = False
         if storage is not None:
             state = storage.read_checkpoint()
@@ -94,6 +100,12 @@ class LocalOrderer:
             # duplicates, and (b) their refSeqs stop pinning the msn
             for cid in list(self.sequencer.clients):
                 self.disconnect(cid)
+
+    @property
+    def inbox_depth(self) -> int:
+        """Undispatched sequenced messages (the deli-inbox depth the
+        qos pressure monitor samples; nonzero only mid-pump)."""
+        return len(self._dispatch_queue)
 
     # ------------------------------------------------------------------
     # ingress (alfred submitOp path)
@@ -150,7 +162,31 @@ class LocalOrderer:
             and self._since_checkpoint >= self._checkpoint_every
         ):
             self._since_checkpoint = 0
+            self._write_checkpoint_guarded()
+
+    def _write_checkpoint_guarded(self) -> None:
+        """Checkpoint write, optionally circuit-broken: with a
+        breaker, a failing disk is recorded (and the breaker
+        eventually refuses instantly instead of paying the fault per
+        op) but sequencing continues — the op log is the recovery
+        path. Without one, faults propagate as before."""
+        if self.storage_breaker is None:
             self.storage.write_checkpoint(self.checkpoint())
+            return
+        from ..qos import BreakerOpenError
+
+        try:
+            self.storage_breaker.call(
+                self.storage.write_checkpoint, self.checkpoint()
+            )
+        except BreakerOpenError:
+            pass  # open: refusal already counted by the breaker
+        except OSError as e:
+            # recorded as a breaker failure by call(); degrade, don't
+            # kill the submit path — restart replays the op log
+            self.lumberjack.log("checkpointFailed", str(e), {
+                "documentId": self.document_id,
+            })
 
     # ------------------------------------------------------------------
     # checkpoint/resume (deli/checkpointContext.ts + scribe state)

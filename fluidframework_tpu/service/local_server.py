@@ -59,9 +59,14 @@ class LocalServer:
     """Multi-document service: one LocalOrderer per document
     (document-parallelism — SURVEY §2.9 axis 1)."""
 
-    def __init__(self, durable_dir: Optional[str] = None) -> None:
+    def __init__(self, durable_dir: Optional[str] = None,
+                 storage_breaker=None) -> None:
         self.documents: dict[str, LocalOrderer] = {}
         self.durable_dir = durable_dir
+        # ONE shared qos.CircuitBreaker across every document's
+        # checkpoint writes (they share the disk, so they share the
+        # failure domain); None = unguarded, as before
+        self.storage_breaker = storage_breaker
         self._conn_counter = itertools.count()
 
     def get_orderer(self, document_id: str) -> LocalOrderer:
@@ -76,7 +81,8 @@ class LocalServer:
                     os.path.join(self.durable_dir, document_id)
                 )
             self.documents[document_id] = LocalOrderer(
-                document_id, storage=storage
+                document_id, storage=storage,
+                storage_breaker=self.storage_breaker,
             )
         return self.documents[document_id]
 

@@ -64,6 +64,12 @@ class OrderingQueue:
     rdkafkaConsumer.ts:37). At-least-once: consumers re-read from the
     committed offset after a crash."""
 
+    # True only when fanout_lag() is in-process arithmetic (safe to
+    # sample on the ingress serving path as a qos pressure source);
+    # networked implementations leave this False — their lag belongs
+    # in an off-loop sampler, never a blocking probe inside admit()
+    fanout_lag_is_local = False
+
     def produce(self, partition: int, document_id: str,
                 payload: dict) -> int:
         raise NotImplementedError
@@ -81,6 +87,12 @@ class OrderingQueue:
 
 
 class InMemoryOrderingQueue(OrderingQueue):
+    # fanout_lag() is in-process arithmetic: safe to sample on the
+    # ingress serving path (qos pressure source). The networked
+    # RemoteOrderingQueue is NOT (blocking round trip) and leaves
+    # this False.
+    fanout_lag_is_local = True
+
     def __init__(self, n_partitions: int):
         self._logs: list[list[QueueRecord]] = [
             [] for _ in range(n_partitions)
@@ -104,12 +116,23 @@ class InMemoryOrderingQueue(OrderingQueue):
         if offset > self._committed[partition]:
             self._committed[partition] = offset
 
+    def fanout_lag(self) -> int:
+        """Produced-but-uncommitted records across all partitions —
+        the consumer-lag signal the qos pressure monitor samples
+        (qos/pressure.py 'broker_fanout' source)."""
+        return sum(
+            len(log) - 1 - committed
+            for log, committed in zip(self._logs, self._committed)
+        )
+
 
 class FileOrderingQueue(OrderingQueue):
     """Durable queue: one append-only jsonl per partition + a committed
     offset file — enough broker semantics (ordered, offset-addressed,
     survives the process) for single-box deployments and for the
     crash-restart tests."""
+
+    fanout_lag_is_local = True  # counters in memory, no I/O
 
     def __init__(self, root: str, n_partitions: int):
         self.root = root
@@ -181,6 +204,15 @@ class FileOrderingQueue(OrderingQueue):
             f.write(str(offset))
         os.replace(tmp, self._commit_path(partition))
         self._committed[partition] = offset
+
+    def fanout_lag(self) -> int:
+        """Produced-but-uncommitted records across all partitions
+        (the qos 'broker_fanout' pressure source; see
+        InMemoryOrderingQueue.fanout_lag)."""
+        return sum(
+            count - 1 - committed
+            for count, committed in zip(self._counts, self._committed)
+        )
 
 
 # ----------------------------------------------------------------------
@@ -312,9 +344,13 @@ class PartitionedOrderingService:
                  durable_dir: Optional[str] = None,
                  copier: Optional[Any] = None,
                  on_nack: Optional[
-                     Callable[[str, str, Nack], None]] = None):
+                     Callable[[str, str, Nack], None]] = None,
+                 storage_breaker: Optional[Any] = None):
         self.n_partitions = n_partitions
         self.durable_dir = durable_dir
+        # shared qos.CircuitBreaker across every document's checkpoint
+        # writes (same semantics as LocalServer.storage_breaker)
+        self.storage_breaker = storage_breaker
         # external nack hook: every partition (including ones created
         # by resume_partition) routes through _dispatch_nack, which
         # records centrally then forwards here
@@ -347,7 +383,8 @@ class PartitionedOrderingService:
             storage = DocumentStorage(
                 os.path.join(self.durable_dir, "docs", document_id)
             )
-        return LocalOrderer(document_id, storage=storage)
+        return LocalOrderer(document_id, storage=storage,
+                            storage_breaker=self.storage_breaker)
 
     # -- producer side (alfred -> queue) -------------------------------
     def partition_of(self, document_id: str) -> int:
@@ -467,15 +504,25 @@ class PartitionedServer:
 
     def __init__(self, n_partitions: int = 4,
                  durable_dir: Optional[str] = None,
-                 copier=None, queue: Optional[OrderingQueue] = None):
+                 copier=None, queue: Optional[OrderingQueue] = None,
+                 storage_breaker=None):
         import itertools as _it
 
         self.svc = PartitionedOrderingService(
             n_partitions=n_partitions, durable_dir=durable_dir,
             copier=copier, on_nack=self._route_nack, queue=queue,
+            storage_breaker=storage_breaker,
         )
         self._nack_routes: dict[tuple[str, str], Any] = {}
         self._conn_counter = _it.count()
+
+    @property
+    def queue(self):
+        """The underlying ordering queue — exposed so the ingress can
+        wire its fanout lag as a qos pressure source (the partitioned
+        deployment's real backpressure signal lives HERE, not in the
+        inline dispatch queue)."""
+        return self.svc.queue
 
     # nacks route to the SUBMITTING client's connection only (alfred
     # emits them on the submitting socket) — the partition hands us

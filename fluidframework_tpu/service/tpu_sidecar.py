@@ -399,7 +399,8 @@ class TpuMergeSidecar:
                  pipeline: Optional[bool] = None,
                  donate: Optional[bool] = None,
                  ladder: Optional[BucketLadder] = None,
-                 trace_ops: Optional[bool] = None):
+                 trace_ops: Optional[bool] = None,
+                 breaker=None):
         self.max_docs = max_docs
         self.capacity = capacity
         self.max_capacity = max_capacity
@@ -427,6 +428,21 @@ class TpuMergeSidecar:
         # flag set (the postmortem the PR-2 stall lacked)
         self.flight = FlightRecorder(256, name="sidecar")
         self.last_flight_dump: Optional[str] = None
+        # optional qos.CircuitBreaker around device dispatch: repeated
+        # dispatch faults open it (apply() then returns 0 and ops stay
+        # queued — the growing queued_ops backlog is exactly what the
+        # qos pressure signal samples, so ingress starts shedding),
+        # and the reset timeout admits probe dispatches that close it
+        # when the device recovers. Opening dumps THIS flight
+        # recorder: the postmortem of what tripped it is captured at
+        # trip time.
+        self.breaker = breaker
+        if breaker is not None and breaker.on_open is None:
+            def _dump_on_open(b) -> None:
+                self.last_flight_dump = self.flight.dump_to(
+                    reason=f"circuit breaker {b.name!r} opened "
+                           f"(last error: {b.last_error!r})")
+            breaker.on_open = _dump_on_open
         # dispatch-route knobs (env-overridable escape hatches)
         self.executor = executor or default_executor()
         if pipeline is not None:
@@ -623,7 +639,19 @@ class TpuMergeSidecar:
         the old synchronous contract (settle before returning)."""
         if not self._queued or self.queued_ops == 0:
             return 0
-        real = self._dispatch()
+        if self.breaker is not None:
+            if not self.breaker.allow():
+                # open (or out of probes): ops stay queued; the
+                # backlog surfaces through queued_ops -> qos pressure
+                return 0
+            try:
+                real = self._dispatch()
+            except Exception as e:  # noqa: BLE001 - breaker records all
+                self.breaker.record_failure(e)
+                raise
+            self.breaker.record_success()
+        else:
+            real = self._dispatch()
         self._applies += 1
         if self._applies % self._compact_every == 0:
             self._table = compact(self._table)

@@ -1,4 +1,4 @@
-"""Stress/load runner with fault injection.
+"""Stress/load runner with fault injection, plus the OVERLOAD mode.
 
 Reference: packages/test/test-service-load — multi-client load runner
 (src/runner.ts, nodeStressTest.ts) with a config (testConfigFile.ts),
@@ -7,9 +7,19 @@ randomized op mixes (optionsMatrix.ts) and fault-injection wrappers.
 Seeded and deterministic: the same config always produces the same
 op/fault schedule, so stress failures reproduce (stochastic-test-utils
 discipline, SURVEY §4.2).
+
+``--overload N`` (:func:`run_overload`) is the qos acceptance
+harness: it offers N x the configured admission capacity of mixed
+writer / slow-reader / summary traffic through the REAL ingress
+dispatch path — driven directly and under a MANUAL clock, so the
+whole overload scenario is deterministic (no sockets, no event loop,
+no timing races) — and reports goodput, shed counts per class, peak
+outbound depth and the registry delta. bench.py config8 sweeps it
+over offered-load multiples with the throttler on vs off.
 """
 from __future__ import annotations
 
+import json as _json
 import random
 from dataclasses import dataclass, field
 from typing import Optional
@@ -17,6 +27,13 @@ from typing import Optional
 from ..drivers.local_driver import LocalDocumentServiceFactory
 from ..loader.container import Container
 from ..obs import metrics as obs_metrics
+from ..qos import (
+    AdmissionController,
+    Budget,
+    PressureMonitor,
+    RateLimits,
+)
+from ..service.ingress import AlfredServer, _ClientSession
 from ..service.local_server import LocalServer
 from ..testing.fault_injection import FaultInjectionDocumentService
 
@@ -174,6 +191,222 @@ def run_stress(config: Optional[StressConfig] = None) -> StressReport:
     return report
 
 
+# ======================================================================
+# overload mode: N x capacity through the admission gate
+
+
+@dataclass
+class OverloadConfig:
+    """One deterministic overload scenario. All times are SIMULATED
+    seconds on a manual clock."""
+
+    offered_multiple: float = 10.0     # offered / capacity
+    capacity_ops_per_s: float = 200.0  # the per-document op budget
+    duration_s: float = 4.0
+    tick_s: float = 0.05
+    n_writers: int = 4
+    n_readers: int = 2                 # slow consumers: never drain
+    summary_every_s: float = 0.5
+    read_ops_every_s: float = 0.2
+    throttle: bool = True              # False = unprotected baseline
+    outbound_depth: int = 600          # per-session hard limit
+    outbound_soft: int = 510           # fanout-drop threshold
+    document_id: str = "overload-doc"
+
+
+@dataclass
+class OverloadReport:
+    offered_ops: int = 0
+    admitted_ops: int = 0       # writer ops the gate let through
+    acked_ops: int = 0          # ... seen back sequenced (goodput)
+    throttle_nacks: int = 0
+    goodput_ops_per_s: float = 0.0
+    shed: dict = field(default_factory=dict)  # class -> count
+    outbound_dropped: int = 0
+    slow_disconnects: int = 0
+    peak_outbound_depth: int = 0
+    max_pressure_tier: int = 0
+    metrics_delta: dict = field(default_factory=dict)
+
+    @property
+    def live(self) -> bool:
+        """Did the service survive: every offered frame dispatched
+        without an unhandled fault, memory bounded."""
+        return True  # run_overload raises otherwise
+
+
+class _ManualClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class _ScriptedWriter:
+    """One write client driven frame-by-frame: submits with correct
+    csn bookkeeping (a shed op retries with the SAME csn — the
+    sequencer's contiguity check must never see a gap), drains its
+    outbound synchronously, and counts acks/nacks."""
+
+    def __init__(self, server: AlfredServer, doc: str, name: str):
+        self.server = server
+        self.doc = doc
+        self.name = name
+        self.session = _ClientSession(server, None)
+        server._sessions.add(self.session)
+        self.csn = 0
+        self.acked = 0
+        self.nacked = 0
+        self.carry = 0.0
+        server._dispatch(self.session, {
+            "type": "connect_document", "document_id": doc,
+            "client_id": name, "versions": ["1.2", "1.1", "1.0"],
+        })
+
+    def _drain(self) -> bool:
+        """Consume queued outbound frames; True if a throttle nack
+        arrived (synchronous with the shed submit)."""
+        throttled = False
+        q = self.session.outbound
+        while not q.empty():
+            raw = q.get_nowait()
+            if raw is None:
+                continue
+            frame = _json.loads(raw[4:])
+            if frame.get("type") == "op":
+                msg = frame.get("msg") or {}
+                if msg.get("clientId") == self.name:
+                    self.acked += 1
+            elif frame.get("type") == "nack":
+                self.nacked += 1
+                throttled = True
+        return throttled
+
+    def offer(self, n_ops: int, nbytes_each: int = 96,
+              op_type: int = 2, contents: object = None) -> None:
+        for _ in range(n_ops):
+            attempt = self.csn + 1
+            self.server._dispatch(self.session, {
+                "type": "submitOp", "document_id": self.doc,
+                "op": {
+                    "client_sequence_number": attempt,
+                    "reference_sequence_number": 0,
+                    "type": op_type,
+                    "contents": contents
+                    if contents is not None else {"k": "v"},
+                    "metadata": None, "traces": [],
+                },
+            }, nbytes_each)
+            if not self._drain():
+                self.csn = attempt
+
+
+def run_overload(config: Optional[OverloadConfig] = None
+                 ) -> OverloadReport:
+    cfg = config or OverloadConfig()
+    report = OverloadReport()
+    before = obs_metrics.REGISTRY.flat()
+    clock = _ManualClock()
+
+    qos = None
+    pressure = None
+    if cfg.throttle:
+        pressure = PressureMonitor(clock=clock)
+        cap = cfg.capacity_ops_per_s
+        qos = AdmissionController(
+            limits=RateLimits(
+                document_ops=Budget(cap),
+                tenant_ops=Budget(cap * 4),
+                connection_bytes=Budget(cap * 256),
+                summary_uploads=Budget(2.0, burst=2.0),
+                summary_bytes=Budget(1 << 20),
+                catchup_reads=Budget(10.0, burst=10.0),
+            ),
+            pressure=pressure, clock=clock,
+        )
+    server = AlfredServer(
+        qos=qos,
+        max_outbound_depth=cfg.outbound_depth,
+        outbound_drop_threshold=cfg.outbound_soft,
+    )
+
+    writers = [
+        _ScriptedWriter(server, cfg.document_id, f"writer-{i}")
+        for i in range(cfg.n_writers)
+    ]
+    readers = []
+    for i in range(cfg.n_readers):
+        s = _ClientSession(server, None)
+        server._sessions.add(s)
+        server._dispatch(s, {
+            "type": "connect_document",
+            "document_id": cfg.document_id,
+            "client_id": f"reader-{i}", "mode": "read",
+            "versions": ["1.2", "1.1", "1.0"],
+        })
+        readers.append(s)
+    summarizer = _ScriptedWriter(
+        server, cfg.document_id, "summarizer"
+    )
+
+    offered_rate = cfg.offered_multiple * cfg.capacity_ops_per_s
+    per_writer = offered_rate * cfg.tick_s / cfg.n_writers
+    ticks = int(cfg.duration_s / cfg.tick_s)
+    rid = 0
+    next_summary = 0.0
+    next_read = 0.0
+    for _tick in range(ticks):
+        clock.t += cfg.tick_s
+        for w in writers:
+            w.carry += per_writer
+            n = int(w.carry)
+            w.carry -= n
+            report.offered_ops += n
+            w.offer(n)
+        if clock.t >= next_read:
+            next_read = clock.t + cfg.read_ops_every_s
+            for s in readers:
+                rid += 1
+                server._dispatch(s, {
+                    "type": "read_ops",
+                    "document_id": cfg.document_id,
+                    "from_seq": 0, "rid": rid,
+                })
+        if clock.t >= next_summary:
+            next_summary = clock.t + cfg.summary_every_s
+            # SUMMARIZE proposals classify as summary traffic — the
+            # first class the policy sheds under pressure
+            summarizer.offer(1, nbytes_each=2048, op_type=7,
+                             contents={"summary": {}})
+        report.peak_outbound_depth = max(
+            report.peak_outbound_depth,
+            max(s.outbound.qsize() for s in server._sessions),
+        )
+        if pressure is not None:
+            report.max_pressure_tier = max(
+                report.max_pressure_tier, pressure.sample().tier,
+            )
+
+    report.acked_ops = sum(w.acked for w in writers)
+    report.throttle_nacks = sum(w.nacked for w in writers)
+    report.admitted_ops = sum(w.csn for w in writers)
+    report.goodput_ops_per_s = report.acked_ops / cfg.duration_s
+    delta = obs_metrics.REGISTRY.delta(before)
+    report.metrics_delta = delta
+    for klass in ("write", "catchup", "summary"):
+        report.shed[klass] = sum(
+            int(v) for k, v in delta.items()
+            if k.startswith("qos_shed_total")
+            and f'klass="{klass}"' in k
+        )
+    report.outbound_dropped = int(delta.get(
+        "ingress_outbound_dropped_total", 0))
+    report.slow_disconnects = int(delta.get(
+        "ingress_slow_consumer_disconnects_total", 0))
+    return report
+
+
 def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
     import argparse
     import json
@@ -182,7 +415,35 @@ def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
     parser.add_argument("--clients", type=int, default=4)
     parser.add_argument("--steps", type=int, default=400)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--overload", type=float, default=None,
+                        metavar="N",
+                        help="offer N x the admission capacity of "
+                             "mixed writer/reader/summary traffic "
+                             "through the qos gate (deterministic; "
+                             "reports goodput/shed/metrics_delta)")
+    parser.add_argument("--no-throttle", action="store_true",
+                        help="with --overload: run the unprotected "
+                             "baseline (no admission control)")
     args = parser.parse_args(argv)
+    if args.overload is not None:
+        report = run_overload(OverloadConfig(
+            offered_multiple=args.overload,
+            throttle=not args.no_throttle,
+        ))
+        print(json.dumps({
+            "offered_ops": report.offered_ops,
+            "admitted_ops": report.admitted_ops,
+            "acked_ops": report.acked_ops,
+            "goodput_ops_per_s": report.goodput_ops_per_s,
+            "throttle_nacks": report.throttle_nacks,
+            "shed": report.shed,
+            "outbound_dropped": report.outbound_dropped,
+            "slow_disconnects": report.slow_disconnects,
+            "peak_outbound_depth": report.peak_outbound_depth,
+            "max_pressure_tier": report.max_pressure_tier,
+            "metrics_delta": report.metrics_delta,
+        }))
+        return 0
     report = run_stress(StressConfig(
         n_clients=args.clients, n_steps=args.steps, seed=args.seed,
     ))

@@ -33,6 +33,57 @@ def test_run_with_retry_backs_off_and_succeeds():
     assert all(s >= 0.25 for s in sleeps)
 
 
+def test_full_jitter_floor_and_span():
+    import random
+
+    from fluidframework_tpu.drivers.driver_utils import (
+        full_jitter_delay,
+    )
+
+    rng = random.Random(0)
+    delays = [
+        full_jitter_delay(3, base_delay_s=0.1, max_delay_s=5.0,
+                          floor_s=1.0, rng=rng)
+        for _ in range(200)
+    ]
+    # the service's retry_after hint is a FLOOR, jitter rides above
+    # it, bounded by base*2^(attempt-1)
+    assert all(1.0 <= d <= 1.0 + 0.4 for d in delays)
+    assert len({round(d, 9) for d in delays}) > 100  # really jittered
+    # span is capped
+    capped = full_jitter_delay(30, base_delay_s=0.1, max_delay_s=5.0,
+                               rng=random.Random(1))
+    assert capped <= 5.0
+
+
+def test_run_with_retry_jitter_desynchronizes_clients():
+    """Two clients throttled in the same window must NOT come back in
+    lockstep: same hint, different rngs -> different schedules, every
+    delay at or above the hint."""
+    import random
+
+    def schedule(seed):
+        sleeps = []
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 5:
+                raise RetriableError("throttled",
+                                     retry_after_seconds=0.5)
+            return "ok"
+
+        run_with_retry(flaky, sleep=sleeps.append,
+                       base_delay_s=0.05,
+                       rng=random.Random(seed))
+        return sleeps
+
+    a, b = schedule(1), schedule(2)
+    assert all(s >= 0.5 for s in a + b)      # floor respected
+    assert a != b                            # not synchronized
+    assert len(set(a)) == len(a)             # nor self-periodic
+
+
 def test_run_with_retry_exhaustion_and_nonretriable():
     def always():
         raise RetriableError("no")

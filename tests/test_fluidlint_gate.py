@@ -108,6 +108,66 @@ def test_obscheck_family_is_in_the_gate():
     assert "obscheck" in core.FAMILIES
 
 
+def test_service_unbounded_queue_rule_fires_in_service_paths(
+        tmp_path):
+    """The service-unbounded-queue rule (qoscheck family): an
+    unbounded asyncio.Queue()/deque() in a service/qos path fails;
+    bounded constructions and justified inline disables pass; the
+    same code OUTSIDE a service path is not the rule's business."""
+    svc_dir = tmp_path / "service"
+    svc_dir.mkdir()
+    bad = svc_dir / "bad.py"
+    bad.write_text(
+        "import asyncio\n"
+        "from collections import deque\n"
+        "class Session:\n"
+        "    def __init__(self):\n"
+        "        self.outbound = asyncio.Queue()\n"            # BAD
+        "        self.infinite = asyncio.Queue(maxsize=0)\n"   # BAD
+        "        self.bounded = asyncio.Queue(maxsize=100)\n"  # ok
+        "        self.log = deque()\n"                         # BAD
+        "        self.ring = deque((), 64)\n"                  # ok
+        "        self.ok = deque(maxlen=8)\n"                  # ok
+        "        self.justified = deque()  "
+        "# fluidlint: disable=service-unbounded-queue -- test\n"
+    )
+    findings = core.run_analysis(
+        roots=[str(bad)], families=["qoscheck"],
+    )
+    assert sorted(f.key for f in findings) == [
+        "bad.py:Session.__init__.infinite",
+        "bad.py:Session.__init__.log",
+        "bad.py:Session.__init__.outbound",
+    ]
+    assert all(
+        f.rule == "service-unbounded-queue" for f in findings
+    )
+
+    # a module's own class named Queue/deque (no import) must not
+    # false-positive, and non-service paths are out of scope
+    other = tmp_path / "elsewhere.py"
+    other.write_text(
+        "import asyncio\n"
+        "q = asyncio.Queue()\n"
+    )
+    assert core.run_analysis(
+        roots=[str(other)], families=["qoscheck"],
+    ) == []
+    own = svc_dir / "own.py"
+    own.write_text(
+        "class deque:\n"
+        "    pass\n"
+        "d = deque()\n"
+    )
+    assert core.run_analysis(
+        roots=[str(own)], families=["qoscheck"],
+    ) == []
+
+
+def test_qoscheck_family_is_in_the_gate():
+    assert "qoscheck" in core.FAMILIES
+
+
 def test_cli_json_mode_exits_zero_on_clean_tree():
     """The `--json` surface BENCH/ADVICE tooling consumes: exit 0 and
     a well-formed empty report on a clean tree."""

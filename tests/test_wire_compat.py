@@ -281,6 +281,109 @@ def test_traces_optional_on_wire_10_peer_interops(alfred):
         svc.close()
 
 
+def test_throttle_nack_qos_fields_optional_on_wire():
+    """Throttle nacks' qos fields (pressure_tier, shed_class) are
+    OPTIONAL on the wire: pre-qos nack frames stay byte-identical
+    (keys absent when unset) and frames from old servers that omit
+    them parse to None — 1.0/1.1 peers interop unchanged."""
+    from fluidframework_tpu.drivers.socket_driver import (
+        SocketDocumentService,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        Nack,
+        NackErrorType,
+    )
+    from fluidframework_tpu.service.ingress import nack_to_json
+
+    # emission: unset fields never serialize (legacy byte-stability)
+    legacy = Nack(operation=None, sequence_number=0,
+                  error_type=NackErrorType.THROTTLING,
+                  message="m", retry_after_seconds=1.5)
+    j = nack_to_json(legacy)
+    assert "pressure_tier" not in j and "shed_class" not in j
+    shed = Nack(operation=None, sequence_number=0,
+                error_type=NackErrorType.THROTTLING, message="m",
+                retry_after_seconds=1.5, pressure_tier=2,
+                shed_class="summary")
+    j2 = nack_to_json(shed)
+    assert j2["pressure_tier"] == 2
+    assert j2["shed_class"] == "summary"
+    # everything else in the frame is unchanged by the new fields
+    assert {k: v for k, v in j2.items()
+            if k not in ("pressure_tier", "shed_class")} == j
+
+    # decode: an OLD server's nack frame (no qos keys) parses clean
+    nacks = []
+    svc = SocketDocumentService.__new__(SocketDocumentService)
+    svc._on_message = None
+    svc._on_nack = nacks.append
+    svc._deliver({
+        "type": "nack", "document_id": "d",
+        "sequence_number": 0,
+        "error_type": int(NackErrorType.THROTTLING),
+        "message": "old-server throttle",
+        "retry_after_seconds": 0.5,
+    })
+    svc._deliver({
+        "type": "nack", "document_id": "d",
+        "sequence_number": 0,
+        "error_type": int(NackErrorType.THROTTLING),
+        "message": "qos shed", "retry_after_seconds": 0.5,
+        "pressure_tier": 1, "shed_class": "write",
+    })
+    assert nacks[0].pressure_tier is None
+    assert nacks[0].shed_class is None
+    assert nacks[0].retry_after_seconds == 0.5
+    assert nacks[1].pressure_tier == 1
+    assert nacks[1].shed_class == "write"
+
+
+def test_throttle_nack_over_wire_10_peer_interops(alfred):
+    """A 1.0-pinned client against a qos-enabled server: the shed
+    nack (carrying the new fields) still round-trips as a valid 1.0
+    nack frame — extra keys ride along, nothing breaks, and the
+    retry hint arrives."""
+    from fluidframework_tpu.protocol.messages import NackErrorType
+    from fluidframework_tpu.qos import (
+        AdmissionController,
+        Budget,
+        RateLimits,
+    )
+
+    qos = AdmissionController(RateLimits(
+        connection_ops=Budget(5.0, burst=2.0),
+    ))
+    server = alfred(qos=qos)
+    svc, c = _load(server.port, "old-qos", "alice",
+                   versions=("1.0",))
+    nacks = []
+    c.on("nack", nacks.append)
+    try:
+        assert svc.agreed_version == "1.0"
+        with svc.lock:
+            t = c.runtime.create_datastore("ds").create_channel(
+                "sharedstring", "t")
+            t.insert_text(0, "a")
+            c.flush()
+        # 1.0 = per-op frames: burn the burst until a shed lands
+        deadline = time.time() + 10.0
+        while not nacks and time.time() < deadline:
+            with svc.lock:
+                if c.connected:
+                    t.insert_text(0, "b")
+                    c.flush()
+            time.sleep(0.01)
+        assert nacks, "no throttle nack reached the 1.0 client"
+        nack = nacks[0]
+        assert nack.error_type == NackErrorType.THROTTLING
+        assert (nack.retry_after_seconds or 0) > 0
+        assert nack.shed_class == "write"
+        with svc.lock:
+            c.close()
+    finally:
+        svc.close()
+
+
 def test_negotiated_10_connection_cannot_use_upload_frames(alfred):
     """Server-side enforcement: a connection that AGREED 1.0 gets a
     loud error for 1.1 frames (not a silent accept)."""
