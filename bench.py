@@ -1727,6 +1727,44 @@ STAGE_FNS = {
 }
 
 
+_FLUIDLINT_CACHE: dict | None = None
+_FLUIDLINT_RAN = False
+
+
+def _fluidlint_counts() -> dict | None:
+    """Per-family fluidlint finding counts (post-suppression, split
+    live vs allowlisted) — the finding TRAJECTORY, machine-readable
+    alongside metrics_registry in every stage record. Computed once
+    per stage process (the tree doesn't change mid-bench); None if
+    the analyzer fails (a broken linter must not lose a measured
+    stage)."""
+    global _FLUIDLINT_CACHE, _FLUIDLINT_RAN
+    if _FLUIDLINT_RAN:
+        return _FLUIDLINT_CACHE
+    _FLUIDLINT_RAN = True
+    try:
+        from fluidframework_tpu.analysis import core as lint
+
+        allow = lint.load_allowlist()
+        findings = lint.run_analysis(families=lint.FAMILIES)
+        kept, _stale = lint.apply_allowlist(findings, allow)
+        kept_ids = {id(f) for f in kept}
+        out: dict = {
+            fam: {"findings": 0, "allowlisted": 0}
+            for fam in lint.FAMILIES
+        }
+        for f in findings:
+            fam = lint.RULE_FAMILY.get(f.rule)
+            if fam not in out:
+                continue
+            bucket = "findings" if id(f) in kept_ids else "allowlisted"
+            out[fam][bucket] += 1
+        _FLUIDLINT_CACHE = out
+    except Exception:  # noqa: BLE001 - counts are best-effort
+        _FLUIDLINT_CACHE = None
+    return _FLUIDLINT_CACHE
+
+
 def _registry_snapshot() -> dict | None:
     """The obs metrics registry, or None if obs failed to import (a
     broken registry must not lose a measured stage)."""
@@ -1755,6 +1793,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         # pack/settle histograms...) — per-stage attribution comes
         # free because each stage runs in its own subprocess
         "metrics_registry": _registry_snapshot(),
+        "fluidlint_findings": _fluidlint_counts(),
     })
     # persist the full-scale result BEFORE the fixed-scale companion:
     # if the companion pushes the child past the subprocess timeout,
@@ -1776,6 +1815,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         fixed["corpus"] = STAGE_CORPUS.get(name)
         fixed["stage_elapsed_s"] = round(time.perf_counter() - t1, 1)
         fixed["metrics_registry"] = _registry_snapshot()
+        fixed["fluidlint_findings"] = _fluidlint_counts()
         result["fixed_scale"] = fixed
         with open(out_path, "w") as f:
             json.dump(result, f)

@@ -8,7 +8,7 @@ invariant families the merge-engine work actually breaks in practice
 (round-5 advisor findings): JAX tracing hazards inside kernels and
 lock discipline around cross-thread state.
 
-Three pass families, one CLI (``python -m fluidframework_tpu.analysis``):
+Six pass families, one CLI (``python -m fluidframework_tpu.analysis``):
 
 - **layercheck** — resolves absolute and relative imports into a
   module graph and enforces the declared layer architecture
@@ -16,11 +16,21 @@ Three pass families, one CLI (``python -m fluidframework_tpu.analysis``):
   test tests/test_layer_check.py asserts against the same map).
 - **jaxhazards** — nondeterminism and recompile hazards reachable from
   jitted code: wall-clock/RNG calls, host callbacks, Python branching
-  on tracer values, unhashable static args.
+  on tracer values, unhashable static args. Reachability crosses
+  module boundaries via the shared call graph (analysis/callgraph.py).
 - **lockcheck** — for every class (or module) that creates a
   ``threading.Lock``/``RLock``, infers which attributes are written
   under it and reports writes that bypass the lock, including writes
   from outside the owning class (the ``break_at`` race shape).
+- **obscheck** / **qoscheck** — observability-contract and
+  overload-safety rules (canonical trace hops; bounded service-plane
+  queues).
+- **concheck** — interprocedural concurrency analysis over the shared
+  call graph: lock-acquisition-order cycles (potential deadlocks),
+  blocking primitives reachable from event-loop coroutines, and
+  awaits holding threading locks. Cross-checked at runtime by the
+  fluidsan lockset sanitizer (testing/sanitizer.py): runtime-observed
+  lock-order edges must stay a subset of the static graph.
 
 Findings are ``path:line: rule-id message``; suppressible per line
 with ``# fluidlint: disable=<rule-id>[,<rule-id>...]`` and

@@ -23,11 +23,68 @@ from .core import (
 )
 
 
+def to_sarif(findings, stale) -> dict:
+    """SARIF 2.1.0 (the interchange format CI diff annotators read).
+    Stale allowlist entries report as tool-level notifications: they
+    have no code location but must not exit 0 silently."""
+    rules = sorted({f.rule for f in findings})
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "fluidlint",
+                    "informationUri":
+                        "docs/ANALYSIS.md",
+                    "rules": [{"id": r} for r in rules],
+                },
+            },
+            "results": [
+                {
+                    "ruleId": f.rule,
+                    "level": "error",
+                    "message": {"text": f.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {"startLine": max(1, f.line)},
+                        },
+                    }],
+                    # the allowlist identity, so annotation tooling
+                    # can dedupe across rebases exactly as the
+                    # ratchet does
+                    "partialFingerprints": {"fluidlintKey": f.key},
+                }
+                for f in findings
+            ],
+            "invocations": [{
+                # SARIF semantics: whether the TOOL ran to completion
+                # — findings do NOT make the run unsuccessful (CI
+                # consumers would discard the results exactly when
+                # there is something to annotate); only a tool-level
+                # fault (stale allowlist) flips it
+                "executionSuccessful": not stale,
+                "toolExecutionNotifications": [
+                    {
+                        "level": "error",
+                        "message": {"text": (
+                            f"stale allowlist entry '{rule} {key}' "
+                            "matches no live finding — delete it"
+                        )},
+                    }
+                    for rule, key in stale
+                ],
+            }],
+        }],
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m fluidframework_tpu.analysis",
         description="fluidlint: layercheck + jaxhazards + lockcheck "
-                    "+ obscheck + qoscheck",
+                    "+ obscheck + qoscheck + concheck",
     )
     parser.add_argument(
         "paths", nargs="*", default=None,
@@ -42,6 +99,10 @@ def main(argv=None) -> int:
         "--json", action="store_true", dest="as_json",
         help="emit findings as JSON "
              "{findings, allowlisted, stale_allowlist}",
+    )
+    parser.add_argument(
+        "--sarif", action="store_true", dest="as_sarif",
+        help="emit findings as SARIF 2.1.0 (CI diff annotation)",
     )
     parser.add_argument(
         "--allowlist", default=ALLOWLIST_PATH,
@@ -76,7 +137,9 @@ def main(argv=None) -> int:
         # on a full default-roots run
         stale = []
 
-    if args.as_json:
+    if args.as_sarif:
+        print(json.dumps(to_sarif(kept, stale), indent=2))
+    elif args.as_json:
         print(json.dumps({
             "findings": [f.to_json() for f in kept],
             "allowlisted": n_allowed,

@@ -135,6 +135,47 @@ def walk_python_files(roots: Iterable[str],
     return out
 
 
+def import_aliases(tree, relative: str = "tail") -> dict:
+    """local name -> dotted origin, from every import in the module
+    (function-local ones included: analyzed bodies may import
+    locally). ONE definition shared by the pass families.
+
+    ``relative`` controls ``from .x import y`` forms: ``"tail"``
+    keeps the module tail (``..obs.trace`` -> ``obs.trace`` — the
+    suffix-matching registries in obscheck/qoscheck need it);
+    ``"skip"`` drops them (jaxhazards matches ABSOLUTE stdlib
+    prefixes, where a relative ``..random`` tail colliding with the
+    stdlib ``random.`` prefix would be a false positive)."""
+    aliases: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.level > 0 and relative == "skip":
+                continue
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_path(node, aliases: dict) -> Optional[str]:
+    """Resolve a Name/Attribute chain to a dotted path with import
+    aliases substituted; None for anything non-static (calls,
+    subscripts). ONE definition — jaxhazards, obscheck, qoscheck and
+    concheck all match registries against the same resolution."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
 def load_allowlist(path: str = ALLOWLIST_PATH) -> list[tuple[str, str]]:
     """Grandfathered findings: one ``<rule-id> <key>`` pair per line,
     ``#`` comments. The gate test enforces the ratchet: every entry
@@ -159,7 +200,26 @@ def load_allowlist(path: str = ALLOWLIST_PATH) -> list[tuple[str, str]]:
 
 
 FAMILIES = ("layercheck", "jaxhazards", "lockcheck", "obscheck",
-            "qoscheck")
+            "qoscheck", "concheck")
+
+# rule id -> owning family: tooling that groups ONE combined run's
+# findings per family (bench's fluidlint_findings records) reads
+# this instead of re-running the analysis once per family. The gate
+# test pins it complete against FAMILIES.
+FAMILY_RULES = {
+    "layercheck": ("layer-undeclared", "layer-cycle"),
+    "jaxhazards": ("jit-nondeterminism", "jit-host-callback",
+                   "jit-tracer-branch", "jit-static-unhashable",
+                   "dispatch-loop-sync"),
+    "lockcheck": ("lock-unlocked-write", "lock-external-write"),
+    "obscheck": ("obs-untimed-hop",),
+    "qoscheck": ("service-unbounded-queue",),
+    "concheck": ("lock-order-cycle", "async-blocking-call",
+                 "await-holding-lock"),
+}
+RULE_FAMILY = {
+    rule: fam for fam, rules in FAMILY_RULES.items() for rule in rules
+}
 
 
 def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
@@ -169,7 +229,14 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
     """Run the selected pass families; returns findings with per-line
     suppressions already applied (allowlist filtering is the caller's
     choice — the CLI and gate apply it, tooling may want raw)."""
-    from . import jaxhazards, layercheck, lockcheck, obscheck, qoscheck
+    from . import (
+        concurrency,
+        jaxhazards,
+        layercheck,
+        lockcheck,
+        obscheck,
+        qoscheck,
+    )
 
     passes = {
         "layercheck": layercheck.check,
@@ -177,6 +244,7 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
         "lockcheck": lockcheck.check,
         "obscheck": obscheck.check,
         "qoscheck": qoscheck.check,
+        "concheck": concurrency.check,
     }
     unknown = [f for f in families if f not in passes]
     if unknown:
@@ -186,8 +254,19 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
     files = walk_python_files(roots, repo_root)
     findings: list[Finding] = []
     by_path = {f.relpath: f for f in files}
+    # one shared call graph per run: jaxhazards and concheck resolve
+    # through the same interprocedural edges (and pay for the build
+    # once)
+    shared_graph = None
+    if {"jaxhazards", "concheck"} & set(families):
+        from .callgraph import build_callgraph
+
+        shared_graph = build_callgraph(files)
     for fam in families:
-        findings.extend(passes[fam](files))
+        if fam in ("jaxhazards", "concheck"):
+            findings.extend(passes[fam](files, graph=shared_graph))
+        else:
+            findings.extend(passes[fam](files))
     kept = []
     for fnd in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
         src = by_path.get(fnd.path)

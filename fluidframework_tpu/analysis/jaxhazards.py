@@ -9,20 +9,29 @@ worst, an unhashable static arg fails at call time, and a host
 callback stalls the device pipeline per step. All four are cheap to
 pin down mechanically.
 
-Detection is module-local and conservative: jit roots are functions
-decorated ``@jax.jit`` / ``@partial(jax.jit, ...)`` or wrapped via
-``jax.jit(fn, ...)`` call forms; reachability follows bare-name calls
-to functions defined in the same module (cross-module reachability
-would need whole-program type inference — out of scope, and kernels
-here are module-contained). ``jax.debug.print`` is NOT flagged: it is
-the sanctioned in-jit debug mechanism.
+Jit roots are functions decorated ``@jax.jit`` /
+``@partial(jax.jit, ...)`` or wrapped via ``jax.jit(fn, ...)`` call
+forms. Reachability is two-tier: the module-local walker follows
+bare-name calls (nested defs included — a jitted closure's helpers
+count), and from every locally-reachable function the shared call
+graph (analysis/callgraph.py) follows resolvable CROSS-MODULE edges —
+imported helpers, imported-module attributes, imported-class methods —
+so a hazard in another module's helper no longer hides behind the
+import boundary. ``jax.debug.print`` is NOT flagged: it is the
+sanctioned in-jit debug mechanism.
 """
 from __future__ import annotations
 
 import ast
 from typing import Optional
 
-from .core import Finding, SourceFile
+from .callgraph import build_callgraph
+from .core import (
+    Finding,
+    SourceFile,
+    dotted_path as _dotted,
+    import_aliases,
+)
 
 # dotted-path prefixes whose call inside jit-reachable code is
 # nondeterministic at trace time
@@ -96,34 +105,10 @@ DISPATCH_LOOPS = {
 
 
 def _import_aliases(tree: ast.AST) -> dict[str, str]:
-    """local name -> dotted path, from every import in the module
-    (function-local ones included: a jitted body may import locally)."""
-    aliases: dict[str, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                aliases[a.asname or a.name.split(".")[0]] = (
-                    a.name if a.asname else a.name.split(".")[0]
-                )
-        elif isinstance(node, ast.ImportFrom) and node.level == 0 \
-                and node.module:
-            for a in node.names:
-                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
-    return aliases
-
-
-def _dotted(node: ast.AST, aliases: dict[str, str]) -> Optional[str]:
-    """Resolve a Name/Attribute chain to a dotted path with import
-    aliases substituted; None for anything non-static (calls,
-    subscripts)."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if not isinstance(node, ast.Name):
-        return None
-    parts.append(aliases.get(node.id, node.id))
-    return ".".join(reversed(parts))
+    # "skip" relative imports: this pass matches ABSOLUTE stdlib
+    # prefixes, and a relative `..random` tail must not collide with
+    # the stdlib `random.` registry entry
+    return import_aliases(tree, relative="skip")
 
 
 def _matches(dotted: str, prefixes: tuple[str, ...]) -> bool:
@@ -356,8 +341,50 @@ def _check_dispatch_loops(files: list[SourceFile],
     return findings
 
 
-def check(files: list[SourceFile]) -> list[Finding]:
+def _scan_effects(fn: ast.AST, aliases: dict, module: str,
+                  relpath: str, findings: list[Finding],
+                  emitted: set) -> None:
+    """Nondeterminism + host-callback calls inside one jit-reachable
+    function, deduped across the local and cross-module walks."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func, aliases)
+        if dotted is None:
+            continue
+        if _matches(dotted, NONDET_PREFIXES):
+            rule, why = "jit-nondeterminism", (
+                "the value is baked in at trace time (one arbitrary "
+                "sample per compile) — pass it in as an argument"
+            )
+        elif _matches(dotted, HOST_CALLBACKS):
+            rule, why = "jit-host-callback", (
+                "host callbacks stall the device pipeline per step "
+                "(use jax.debug.print for debugging, or move the "
+                "effect outside the kernel)"
+            )
+        else:
+            continue
+        key = f"{module}:{fn.name}:{dotted}"
+        if (rule, key) in emitted:
+            continue
+        emitted.add((rule, key))
+        findings.append(Finding(
+            rule=rule, path=relpath, line=node.lineno,
+            message=(
+                f"{dotted}() inside jit-reachable {fn.name}(): {why}"
+            ),
+            key=key,
+        ))
+
+
+def check(files: list[SourceFile], graph=None) -> list[Finding]:
     findings = _check_dispatch_loops(files)
+    graph = graph or build_callgraph(files)
+    emitted: set = set()
+    # cross-module frontier: FunctionInfos (keyed by node id) reached
+    # from any module's jit roots through resolvable imported edges
+    foreign_seeds: dict[int, object] = {}
     for src in files:
         if src.tree is None:
             continue
@@ -368,38 +395,21 @@ def check(files: list[SourceFile]) -> list[Finding]:
         module = src.relpath.rsplit("/", 1)[-1]
 
         # -- nondeterminism + host callbacks in jit-reachable code ----
-        for fn in _reachable(roots, src.tree):
+        local_fns = _reachable(roots, src.tree)
+        for fn in local_fns:
+            _scan_effects(fn, aliases, module, src.relpath, findings,
+                          emitted)
+        # cross-module callees of everything locally reachable: the
+        # shared call graph resolves imported helpers the bare-name
+        # walker cannot see
+        for fn in local_fns:
+            caller = graph.info_for_node(fn)
             for node in ast.walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
-                dotted = _dotted(node.func, aliases)
-                if dotted is None:
-                    continue
-                if _matches(dotted, NONDET_PREFIXES):
-                    findings.append(Finding(
-                        rule="jit-nondeterminism",
-                        path=src.relpath, line=node.lineno,
-                        message=(
-                            f"{dotted}() inside jit-reachable "
-                            f"{fn.name}(): the value is baked in at "
-                            "trace time (one arbitrary sample per "
-                            "compile) — pass it in as an argument"
-                        ),
-                        key=f"{module}:{fn.name}:{dotted}",
-                    ))
-                elif _matches(dotted, HOST_CALLBACKS):
-                    findings.append(Finding(
-                        rule="jit-host-callback",
-                        path=src.relpath, line=node.lineno,
-                        message=(
-                            f"{dotted}() inside jit-reachable "
-                            f"{fn.name}(): host callbacks stall the "
-                            "device pipeline per step (use "
-                            "jax.debug.print for debugging, or move "
-                            "the effect outside the kernel)"
-                        ),
-                        key=f"{module}:{fn.name}:{dotted}",
-                    ))
+                for target in graph.resolve_call(node, caller, src):
+                    if target.relpath != src.relpath:
+                        foreign_seeds[id(target.node)] = target
 
         # -- per-root: tracer branches + unhashable statics ------------
         for root in roots:
@@ -479,4 +489,14 @@ def check(files: list[SourceFile]) -> list[Finding]:
                         ),
                         key=f"{module}:{fn.name}:{a.arg}",
                     ))
+
+    # -- cross-module reachability: scan every function the shared
+    # call graph reaches from the per-module frontiers, with the
+    # DEFINING module's aliases (a hazard reports in its own file) ---
+    for info in graph.reachable(foreign_seeds.values()):
+        _scan_effects(
+            info.node, graph.module_aliases(info.relpath),
+            info.relpath.rsplit("/", 1)[-1], info.relpath,
+            findings, emitted,
+        )
     return findings

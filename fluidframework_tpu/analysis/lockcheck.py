@@ -274,6 +274,13 @@ def _analyze_scope(scope: _Scope, relpath: str,
             writes.setdefault(a.attr, []).append(a)
 
     findings = []
+    # module-scope keys carry the module filename: "<module>.attr"
+    # alone would collide across files (one allowlist entry silently
+    # grandfathering every module's same-named global). Class keys
+    # stay bare — class names are already tree-unique identities.
+    module = relpath.rsplit("/", 1)[-1]
+    key_scope = f"{module}:{scope.name}" \
+        if scope.name == "<module>" else scope.name
     for attr, evs in sorted(writes.items()):
         locked = [e for e in evs if effective(e)]
         if not locked:
@@ -296,7 +303,7 @@ def _analyze_scope(scope: _Scope, relpath: str,
                     "a concurrent locked reader can observe a torn "
                     "update"
                 ),
-                key=f"{scope.name}.{attr}",
+                key=f"{key_scope}.{attr}",
             ))
     # public attrs the class WRITES under its lock: the class chose to
     # serialize mutation, so a raw external write bypasses an existing

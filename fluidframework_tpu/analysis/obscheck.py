@@ -22,7 +22,13 @@ import ast
 import os
 from typing import Optional
 
-from .core import Finding, PKG_ROOT, SourceFile
+from .core import (
+    Finding,
+    PKG_ROOT,
+    SourceFile,
+    dotted_path as _dotted,
+    import_aliases,
+)
 
 _TRACE_PATH = os.path.join(PKG_ROOT, "obs", "trace.py")
 
@@ -51,34 +57,10 @@ def load_canonical_hops(path: str = _TRACE_PATH) -> set[tuple]:
 
 
 def _import_aliases(tree: ast.AST) -> dict[str, str]:
-    """local name -> dotted path (module-level and local imports)."""
-    aliases: dict[str, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                aliases[a.asname or a.name.split(".")[0]] = (
-                    a.name if a.asname else a.name.split(".")[0]
-                )
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            # relative imports keep the module tail (``..obs.trace``
-            # -> ``obs.trace``): suffix matching below doesn't need
-            # the absolute package prefix
-            for a in node.names:
-                aliases[a.asname or a.name] = (
-                    f"{node.module}.{a.name}"
-                )
-    return aliases
-
-
-def _dotted(node: ast.AST, aliases: dict[str, str]) -> Optional[str]:
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if not isinstance(node, ast.Name):
-        return None
-    parts.append(aliases.get(node.id, node.id))
-    return ".".join(reversed(parts))
+    # relative imports keep the module tail (``..obs.trace`` ->
+    # ``obs.trace``): suffix matching below doesn't need the absolute
+    # package prefix
+    return import_aliases(tree, relative="tail")
 
 
 def _matches_suffix(dotted: str, suffixes: tuple[str, ...]) -> bool:

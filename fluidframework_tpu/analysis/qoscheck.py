@@ -24,7 +24,12 @@ from __future__ import annotations
 import ast
 from typing import Optional
 
-from .core import Finding, SourceFile
+from .core import (
+    Finding,
+    SourceFile,
+    dotted_path as _dotted,
+    import_aliases,
+)
 
 # dotted-path suffixes that construct a queue-like container, and the
 # keyword (or positional index) that bounds it
@@ -46,32 +51,9 @@ def _in_scope(relpath: str) -> bool:
 
 
 def _import_aliases(tree: ast.AST) -> dict[str, str]:
-    """local name -> dotted origin (same resolution style as
-    obscheck: imports give the dotted path suffix matching keys on)."""
-    aliases: dict[str, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                aliases[a.asname or a.name.split(".")[0]] = (
-                    a.name if a.asname else a.name.split(".")[0]
-                )
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            for a in node.names:
-                aliases[a.asname or a.name] = (
-                    f"{node.module}.{a.name}"
-                )
-    return aliases
-
-
-def _dotted(node: ast.AST, aliases: dict[str, str]) -> Optional[str]:
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if not isinstance(node, ast.Name):
-        return None
-    parts.append(aliases.get(node.id, node.id))
-    return ".".join(reversed(parts))
+    # same resolution style as obscheck: relative-import tails stay,
+    # suffix matching keys on them
+    return import_aliases(tree, relative="tail")
 
 
 def _bound_spec(dotted: str) -> Optional[tuple[str, int]]:

@@ -71,6 +71,15 @@ class BrokerServer:
         self.n_partitions = n_partitions
         self.host = host
         self.port = port
+        # _dispatch runs on executor threads — the durable
+        # FileOrderingQueue appends/commits are disk writes, which
+        # must never run on the event loop (the same
+        # async-blocking-call shape concheck pinned in moira; here
+        # the I/O hides behind the queue seam, out of static
+        # resolution's reach, so this fix is belt-and-suspenders).
+        # The lock serializes queue access across connections exactly
+        # as the loop used to.
+        self._state_lock = threading.Lock()
         self._server: Optional[asyncio.base_events.Server] = None
         self._writers: set[asyncio.StreamWriter] = set()
 
@@ -102,13 +111,15 @@ class BrokerServer:
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         self._writers.add(writer)
+        loop = asyncio.get_running_loop()
         try:
             while True:
                 frame = await read_frame(reader)
                 if frame is None:
                     break
                 try:
-                    resp = self._dispatch(frame)
+                    resp = await loop.run_in_executor(
+                        None, self._dispatch_locked, frame)
                 except Exception as e:  # noqa: BLE001 - report per frame
                     _BROKER_ERRORS.inc()
                     resp = {
@@ -126,6 +137,10 @@ class BrokerServer:
             except (ConnectionResetError, BrokenPipeError,
                     RuntimeError):
                 pass  # loop shutting down mid-close is fine
+
+    def _dispatch_locked(self, frame: dict) -> dict:
+        with self._state_lock:
+            return self._dispatch(frame)
 
     def _dispatch(self, frame: dict) -> dict:
         kind = frame.get("type")

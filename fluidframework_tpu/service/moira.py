@@ -72,6 +72,11 @@ class MaterializedHistoryServer:
         self.port = port
         self.data_dir = data_dir
         self.branches: dict[str, dict] = {}
+        # _dispatch runs on executor threads (its _persist does file
+        # I/O, which must never run on the event loop — concheck's
+        # async-blocking-call rule); the lock serializes branch-state
+        # access across connections exactly as the loop used to
+        self._state_lock = threading.Lock()
         self._server: Optional[asyncio.base_events.Server] = None
         if data_dir is not None:
             os.makedirs(data_dir, exist_ok=True)
@@ -108,13 +113,18 @@ class MaterializedHistoryServer:
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        loop = asyncio.get_running_loop()
         try:
             while True:
                 frame = await read_frame(reader)
                 if frame is None:
                     break
                 try:
-                    resp = self._dispatch(frame)
+                    # executor hop: _persist writes the branch log to
+                    # disk, and a disk stall must park only THIS
+                    # request, not every connection on the loop
+                    resp = await loop.run_in_executor(
+                        None, self._dispatch_locked, frame)
                 except Exception as e:  # noqa: BLE001 - per frame
                     resp = {"type": "error",
                             "message": f"{type(e).__name__}: {e}"}
@@ -128,6 +138,10 @@ class MaterializedHistoryServer:
             except (ConnectionResetError, BrokenPipeError,
                     RuntimeError):
                 pass
+
+    def _dispatch_locked(self, frame: dict) -> dict:
+        with self._state_lock:
+            return self._dispatch(frame)
 
     def _dispatch(self, frame: dict) -> dict:
         kind = frame.get("type")

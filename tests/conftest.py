@@ -28,6 +28,35 @@ def pytest_configure(config):
 
 import pytest  # noqa: E402
 
+# fluidsan (testing/sanitizer.py): FFTPU_SANITIZE=1 instruments every
+# threading.Lock/RLock created during the session with the lockset
+# sanitizer. Installed at conftest import — BEFORE test modules
+# import — so locks created at test-module import time are wrapped
+# too. The autouse guard below fails any test that trips it.
+_SANITIZE = os.environ.get("FFTPU_SANITIZE") == "1"
+if _SANITIZE:
+    from fluidframework_tpu.testing import sanitizer as _fluidsan
+
+    _fluidsan.install()
+
+
+@pytest.fixture(autouse=True)
+def _fluidsan_trip_guard():
+    if not _SANITIZE:
+        yield
+        return
+    from fluidframework_tpu.testing import sanitizer
+
+    before = len(sanitizer.trips())
+    yield
+    fresh = sanitizer.trips()[before:]
+    if fresh:
+        pytest.fail(
+            "fluidsan tripped during this test:\n"
+            + "\n".join(t.describe() for t in fresh)
+            + "\n" + fresh[0].flight_dump
+        )
+
 
 @pytest.fixture()
 def alfred(monkeypatch):

@@ -492,7 +492,8 @@ def test_lockcheck_module_level_lock_discipline(tmp_path):
     }, families=["lockcheck"])
     assert _rules(findings) == {"lock-unlocked-write"}
     (hit,) = findings
-    assert "poison" in hit.message and hit.key == "<module>._cache"
+    assert "poison" in hit.message
+    assert hit.key == "registry.py:<module>._cache"
 
 
 # ------------------------------------------------- suppression + allowlist
@@ -579,6 +580,562 @@ def test_nonexistent_scan_path_is_an_error_not_a_clean_pass(tmp_path):
     with pytest.raises(ValueError, match="no_such_dir"):
         run_analysis(roots=["no_such_dir"], repo_root=str(tmp_path))
     assert main(["fluidframework_tpu/no_such_file.py"]) == 2
+
+
+# ---------------------------------------------------------------- callgraph
+
+
+def test_jaxhazards_flags_cross_module_hazard_via_callgraph(tmp_path):
+    """The shared call graph (analysis/callgraph.py) lets jit roots
+    see CROSS-MODULE callees: a nondeterministic call inside an
+    imported helper is flagged in the helper's own file. The old
+    module-local walker missed exactly this shape (neither module
+    alone produces a finding: kernel.py has no local hazard,
+    helpers.py has no jit root)."""
+    files = {
+        "src/kernel.py": """
+            import jax
+
+            from src.helpers import fuzz
+
+            @jax.jit
+            def step(x):
+                return fuzz(x)
+        """,
+        "src/helpers.py": """
+            import time
+
+            def fuzz(x):
+                return x * time.time()
+        """,
+    }
+    findings = _lint(tmp_path, files, families=["jaxhazards"])
+    assert _rules(findings) == {"jit-nondeterminism"}
+    (hit,) = findings
+    assert hit.path.endswith("helpers.py")
+    assert hit.key == "helpers.py:fuzz:time.time"
+
+    # the helper's module alone has no jit root: no finding (pins
+    # that the cross-module finding really came through the graph)
+    solo = _lint(tmp_path / "solo", {
+        "src/helpers.py": files["src/helpers.py"],
+    }, families=["jaxhazards"])
+    assert solo == []
+
+
+def test_jaxhazards_cross_module_does_not_double_report(tmp_path):
+    """A helper reachable both locally (own-module jit root) and from
+    another module's root reports ONCE."""
+    findings = _lint(tmp_path, {
+        "src/kernel.py": """
+            import jax
+
+            from src.helpers import fuzz
+
+            @jax.jit
+            def step(x):
+                return fuzz(x)
+        """,
+        "src/helpers.py": """
+            import random
+
+            import jax
+
+            def fuzz(x):
+                return x + random.random()
+
+            @jax.jit
+            def own_root(x):
+                return fuzz(x)
+        """,
+    }, families=["jaxhazards"])
+    assert [f.key for f in findings] == ["helpers.py:fuzz:random.random"]
+
+
+# ------------------------------------------------------------------ concheck
+
+
+def test_concheck_flags_cross_module_lock_order_cycle(tmp_path):
+    """lock-order-cycle: module A takes its lock then calls into
+    module B (which takes B's lock); module B also takes its lock and
+    calls back into A. The opposite-order pair is a potential
+    deadlock no single-module scan can see."""
+    findings = _lint(tmp_path, {
+        "service/locks_a.py": """
+            import threading
+
+            from service.locks_b import poke
+
+            _lock_a = threading.Lock()
+
+            def ping():
+                with _lock_a:
+                    poke()
+
+            def handle_a():
+                with _lock_a:
+                    pass
+        """,
+        "service/locks_b.py": """
+            import threading
+
+            from service.locks_a import handle_a
+
+            _lock_b = threading.Lock()
+
+            def poke():
+                with _lock_b:
+                    pass
+
+            def pong():
+                with _lock_b:
+                    handle_a()
+        """,
+    }, families=["concheck"])
+    hits = [f for f in findings if f.rule == "lock-order-cycle"]
+    assert len(hits) == 1
+    (hit,) = hits
+    assert hit.key == (
+        "cycle:locks_a.py:<module>._lock_a"
+        "<->locks_b.py:<module>._lock_b"
+    )
+    assert "deadlock" in hit.message
+
+
+def test_concheck_multi_item_with_records_left_to_right_order(
+        tmp_path):
+    """`with self.a, self.b:` acquires left to right — the a->b edge
+    must exist, so the reverse nesting elsewhere is a cycle (this was
+    a false negative: the combined form recorded both items against
+    the pre-with held set)."""
+    findings = _lint(tmp_path, {
+        "service/combined.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self.a = threading.Lock()
+                    self.b = threading.Lock()
+
+                def both(self):
+                    with self.a, self.b:
+                        pass
+
+                def reversed_nesting(self):
+                    with self.b:
+                        with self.a:
+                            pass
+        """,
+    }, families=["concheck"])
+    hits = [f for f in findings if f.rule == "lock-order-cycle"]
+    assert len(hits) == 1
+    # the message carries BOTH real directed edges with their call
+    # paths, and the location is a real witness line, not a default
+    assert "combined.py:Box.a -> combined.py:Box.b" in hits[0].message
+    assert "combined.py:Box.b -> combined.py:Box.a" in hits[0].message
+    assert hits[0].line > 1
+
+
+def test_concheck_nested_def_offload_is_not_async_blocking(tmp_path):
+    """The canonical offload idiom — a nested def passed to
+    run_in_executor — must NOT flag: the closure runs on an executor
+    thread. A nested def the coroutine CALLS in place must still
+    flag."""
+    findings = _lint(tmp_path, {
+        "service/nested.py": """
+            import asyncio
+            import time
+
+            async def offloads(loop):
+                def work():
+                    time.sleep(1)
+                return await loop.run_in_executor(None, work)
+
+            async def calls_in_place():
+                def work():
+                    time.sleep(1)
+                work()
+        """,
+    }, families=["concheck"])
+    assert [f.key for f in findings] == [
+        "nested.py:calls_in_place:time.sleep",
+    ]
+
+
+def test_concheck_lock_order_clean_on_consistent_global_order(tmp_path):
+    findings = _lint(tmp_path, {
+        "service/locks_a.py": """
+            import threading
+
+            from service.locks_b import poke
+
+            _lock_a = threading.Lock()
+
+            def ping():
+                with _lock_a:
+                    poke()
+        """,
+        "service/locks_b.py": """
+            import threading
+
+            _lock_b = threading.Lock()
+
+            def poke():
+                with _lock_b:
+                    pass
+        """,
+    }, families=["concheck"])
+    assert [f for f in findings if f.rule == "lock-order-cycle"] == []
+
+
+def test_concheck_flags_nonreentrant_self_deadlock(tmp_path):
+    """Re-acquiring a plain (non-reentrant) Lock through a helper the
+    locked region calls is a guaranteed self-deadlock."""
+    findings = _lint(tmp_path, {
+        "service/selfdead.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """,
+    }, families=["concheck"])
+    hits = [f for f in findings if f.rule == "lock-order-cycle"]
+    assert len(hits) == 1
+    assert "re-acquires" in hits[0].message
+
+    # the identical shape on an RLock is reentrant and legal
+    rfind = _lint(tmp_path / "r", {
+        "service/selfsafe.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """,
+    }, families=["concheck"])
+    assert [f for f in rfind if f.rule == "lock-order-cycle"] == []
+
+
+def test_concheck_flags_blocking_calls_reachable_from_async(tmp_path):
+    """async-blocking-call: blocking primitives (socket I/O via a
+    cross-module helper, time.sleep via a local helper) reachable
+    from an async def in a service path stall the event loop."""
+    findings = _lint(tmp_path, {
+        "service/pump.py": """
+            import asyncio
+            import time
+
+            from service.wireutil import read_blocking
+
+            async def handle(reader):
+                data = read_blocking()
+                await asyncio.sleep(0)       # asyncio-native: fine
+                _log(data)
+                return data
+
+            def _log(data):
+                time.sleep(0.1)
+        """,
+        "service/wireutil.py": """
+            import socket
+
+            def read_blocking():
+                s = socket.create_connection(("h", 1))
+                return s.recv(4)
+        """,
+    }, families=["concheck"])
+    assert _rules(findings) == {"async-blocking-call"}
+    assert sorted(f.key for f in findings) == [
+        "pump.py:_log:time.sleep",
+        "wireutil.py:read_blocking:recv",
+        "wireutil.py:read_blocking:socket.create_connection",
+    ]
+    # the finding lands in the blocking callee's own file, naming the
+    # async root it is reachable from
+    wire = [f for f in findings if f.path.endswith("wireutil.py")]
+    assert all("handle" in f.message for f in wire)
+
+
+def test_concheck_async_blocking_exemptions(tmp_path):
+    """The executor hop is the sanctioned escape: a function passed to
+    run_in_executor/to_thread is an argument, not a call — no edge, no
+    finding. Non-service paths are out of the rule's scope."""
+    findings = _lint(tmp_path, {
+        "service/offload.py": """
+            import asyncio
+            import time
+
+            def _work():
+                time.sleep(0.1)
+
+            async def handle(loop):
+                return await loop.run_in_executor(None, _work)
+
+            async def handle2():
+                return await asyncio.to_thread(_work)
+        """,
+        # same blocking shape outside drivers/service/qos: not a root
+        "lib/other.py": """
+            import time
+
+            async def handle():
+                time.sleep(0.1)
+        """,
+    }, families=["concheck"])
+    assert findings == []
+
+
+def test_concheck_flags_slow_lock_acquisition_from_async(tmp_path):
+    """A lock held across blocking I/O ANYWHERE makes acquiring it
+    from async code a blocking call (the coroutine can wait out the
+    whole I/O); a fast lock (short critical section over memory) is
+    deliberately not flagged."""
+    findings = _lint(tmp_path, {
+        "service/slowlock.py": """
+            import socket
+            import threading
+
+            class Client:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._fast = threading.Lock()
+                    self._n = 0
+
+                def request(self):
+                    with self._lock:
+                        self._sock.sendall(b"x")
+
+                def bump(self):
+                    with self._fast:
+                        self._n += 1
+
+                async def poll(self):
+                    with self._fast:
+                        pass
+                    with self._lock:
+                        return self._n
+        """,
+    }, families=["concheck"])
+    hits = [f for f in findings if f.rule == "async-blocking-call"]
+    assert [f.key for f in hits] == [
+        "slowlock.py:Client.poll:with-_lock",
+    ]
+    assert "slow lock" in hits[0].message
+
+
+def test_concheck_flags_await_holding_lock(tmp_path):
+    findings = _lint(tmp_path, {
+        "service/mixy.py": """
+            import asyncio
+            import threading
+
+            class Mix:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                async def bad(self, coro):
+                    with self._lock:
+                        return await coro
+
+                async def ok(self, coro):
+                    with self._lock:
+                        x = 1
+                    return await coro
+        """,
+    }, families=["concheck"])
+    hits = [f for f in findings if f.rule == "await-holding-lock"]
+    assert [f.key for f in hits] == ["mixy.py:Mix.bad:_lock"]
+    assert "asyncio.Lock" in hits[0].message
+
+
+def test_concheck_queue_and_event_receivers_are_type_tracked(tmp_path):
+    """queue.Queue.get/Event.wait block only when the receiver's
+    constructor is visible; an unrelated object's .get/.wait must not
+    fire (no duck-typed false positives)."""
+    findings = _lint(tmp_path, {
+        "service/inbox.py": """
+            import queue
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._inbox = queue.Queue()
+                    self._ready = threading.Event()
+                    self._config = {}
+
+                async def drain(self):
+                    self._config.get("x")          # dict.get: fine
+                    self._ready.wait(1.0)          # Event.wait: BAD
+                    return self._inbox.get()       # Queue.get: BAD
+        """,
+    }, families=["concheck"])
+    assert sorted(f.key for f in findings) == [
+        "inbox.py:Pump.drain:get",
+        "inbox.py:Pump.drain:wait",
+    ]
+    assert _rules(findings) == {"async-blocking-call"}
+
+
+def test_concheck_keys_distinguish_same_named_methods(tmp_path):
+    """Two classes in one module with a same-named blocking coroutine
+    must get DISTINCT keys — one allowlist entry (or SARIF
+    fingerprint) must never grandfather both."""
+    findings = _lint(tmp_path, {
+        "service/dup.py": """
+            import time
+
+            class A:
+                async def handle(self):
+                    time.sleep(1)
+
+            class B:
+                async def handle(self):
+                    time.sleep(2)
+        """,
+    }, families=["concheck"])
+    assert sorted(f.key for f in findings) == [
+        "dup.py:A.handle:time.sleep",
+        "dup.py:B.handle:time.sleep",
+    ]
+
+
+def test_callgraph_resolves_deep_dotted_chains_through_packages(
+        tmp_path):
+    """`import pkg.service.util` + `pkg.service.util.slow()` must
+    resolve through the dotted index even when `pkg` itself is a
+    scanned package (the root __init__.py used to shadow the
+    fallback and silently drop the edge)."""
+    findings = _lint(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/service/__init__.py": "",
+        "pkg/service/util.py": """
+            import time
+
+            def slow():
+                time.sleep(1)
+        """,
+        "pkg/service/pump.py": """
+            import pkg.service.util
+
+            async def handle():
+                pkg.service.util.slow()
+        """,
+    }, families=["concheck"])
+    assert [f.key for f in findings] == ["util.py:slow:time.sleep"]
+
+
+# -------------------------------------------------- key stability (ratchet)
+
+
+def test_finding_keys_are_line_free_across_all_families(tmp_path):
+    """Allowlist keys must survive unrelated edits: inserting lines
+    ABOVE a finding must not change any family's key (a line-keyed
+    family would churn the allowlist on every edit — the ratchet
+    would misreport fixed debt)."""
+    files = {
+        # layercheck + lockcheck + concheck + jaxhazards + obscheck +
+        # qoscheck all fire at least once
+        "fluidframework_tpu/protocol/__init__.py": "",
+        "fluidframework_tpu/service/__init__.py": "",
+        "fluidframework_tpu/protocol/bad.py": """
+            from ..service import broker
+        """,
+        "fluidframework_tpu/service/hot.py": """
+            import asyncio
+            import threading
+            import time
+
+            q = asyncio.Queue()
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def reset(self):
+                    self._n = 0
+
+                async def poll(self):
+                    time.sleep(0.1)
+        """,
+        "src/kernel.py": """
+            import time
+
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * time.time()
+        """,
+    }
+    baseline = _lint(tmp_path, dict(files),
+                     families=["layercheck", "jaxhazards", "lockcheck",
+                               "qoscheck", "concheck"])
+    assert len(baseline) >= 5
+    shifted_files = {
+        # indentation matches the fixture bodies so dedent still
+        # normalizes them; only the line NUMBERS move
+        path: ("\n            # shifted\n            # shifted" + src
+               if src.strip() else src)
+        for path, src in files.items()
+    }
+    shifted = _lint(tmp_path / "shifted", shifted_files,
+                    families=["layercheck", "jaxhazards", "lockcheck",
+                              "qoscheck", "concheck"])
+    assert sorted((f.rule, f.key) for f in baseline) == \
+        sorted((f.rule, f.key) for f in shifted)
+    # lines DID move — the keys being equal is not vacuous
+    assert sorted(f.line for f in baseline) != \
+        sorted(f.line for f in shifted)
+
+
+def test_lockcheck_module_scope_keys_carry_the_module_name(tmp_path):
+    """Two files with module-level locks guarding same-named globals
+    must not collide on one '<module>.attr' allowlist key."""
+    src = """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = None
+
+        def load():
+            global _cache
+            with _lock:
+                _cache = 1
+
+        def poison():
+            global _cache
+            _cache = None
+    """
+    findings = _lint(tmp_path, {
+        "src/reg_a.py": src,
+        "src/reg_b.py": src,
+    }, families=["lockcheck"])
+    assert sorted(f.key for f in findings) == [
+        "reg_a.py:<module>._cache",
+        "reg_b.py:<module>._cache",
+    ]
 
 
 def test_partial_path_scan_does_not_enforce_allowlist_staleness(
