@@ -212,7 +212,7 @@ FAMILY_RULES = {
                    "jit-tracer-branch", "jit-static-unhashable",
                    "dispatch-loop-sync"),
     "lockcheck": ("lock-unlocked-write", "lock-external-write"),
-    "obscheck": ("obs-untimed-hop",),
+    "obscheck": ("obs-untimed-hop", "slo-unbound-objective"),
     "qoscheck": ("service-unbounded-queue",),
     "concheck": ("lock-order-cycle", "async-blocking-call",
                  "await-holding-lock"),

@@ -15,6 +15,20 @@ The canonical table is read from the obs source with
 lints" property (no runtime import of the package under analysis),
 and the table is required to stay a pure literal for exactly this
 reason.
+
+``slo-unbound-objective``: every declared SLO objective must bind to
+a metric family registered in ``obs.metrics`` — a latency objective
+to a HISTOGRAM, a goodput objective's good/total pair to COUNTERS.
+The runtime half (``SloEngine.add_objective`` raising ``ValueError``
+on an unregistered family) only fires when the engine is actually
+constructed on that code path; the static half catches the
+misspelled-metric / renamed-family drift at lint time, on every
+declaration. Registered names are collected from ``.counter(...)`` /
+``.gauge(...)`` / ``.histogram(...)`` registration calls with
+literal names — first across the scanned files, then (so a
+partial-path scan of a module whose objectives bind to families
+registered elsewhere stays clean) across the real package tree.
+Dynamic metric names are left to the runtime check.
 """
 from __future__ import annotations
 
@@ -82,8 +96,132 @@ def _literal_str(node: ast.AST) -> Optional[str]:
     return None
 
 
+# ------------------------------------------------------- slo objectives
+
+# import spellings of the Objective dataclass (obs/slo.py). Bare
+# ``Objective`` with no import alias deliberately does NOT match —
+# same reasoning as _matches_suffix above.
+_OBJECTIVE_SUFFIXES = ("obs.slo.Objective", "obs.Objective",
+                       "slo.Objective")
+# registry factory method names: ``<anything>.histogram("name", ...)``
+# registers a family. Matching on the attribute name alone is
+# deliberate — registries travel under many local names (the
+# process-wide REGISTRY, get_registry(), an injected instance) and a
+# too-narrow match would silently un-enforce the rule.
+_METRIC_FACTORIES = ("counter", "gauge", "histogram")
+
+_REAL_REGISTRATIONS: Optional[dict] = None
+
+
+def collect_registrations(files: list[SourceFile]) -> dict[str, str]:
+    """metric family name -> kind, from every registration call with
+    a literal name in ``files``."""
+    out: dict[str, str] = {}
+    for src in files:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_FACTORIES
+                and node.args
+            ):
+                name = _literal_str(node.args[0])
+                if name is not None:
+                    out[name] = node.func.attr
+    return out
+
+
+def _package_registrations() -> dict[str, str]:
+    """Registrations across the real package tree (memoized): the
+    fallback universe for partial-path scans, where the scanned files
+    may declare objectives whose families are registered in modules
+    outside the scan."""
+    global _REAL_REGISTRATIONS
+    if _REAL_REGISTRATIONS is None:
+        from .core import walk_python_files
+
+        _REAL_REGISTRATIONS = collect_registrations(
+            walk_python_files([PKG_ROOT])
+        )
+    return _REAL_REGISTRATIONS
+
+
+def _kind_of(name: str, local: dict[str, str]) -> Optional[str]:
+    kind = local.get(name)
+    if kind is None:
+        kind = _package_registrations().get(name)
+    return kind
+
+
+def _objective_kwargs(node: ast.Call) -> dict[str, ast.AST]:
+    """Objective(...) arguments by parameter name (positional forms
+    mapped through the dataclass field order)."""
+    params = ("name", "metric", "threshold_ms", "target", "kind",
+              "good_metric", "total_metric", "labels")
+    out = dict(zip(params, node.args))
+    for kw in node.keywords:
+        if kw.arg is not None:
+            out[kw.arg] = kw.value
+    return out
+
+
+def _check_objectives(src: SourceFile, aliases: dict,
+                      registered: dict[str, str],
+                      findings: list) -> None:
+    module = src.relpath.rsplit("/", 1)[-1]
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func, aliases)
+        if dotted is None or not _matches_suffix(
+                dotted, _OBJECTIVE_SUFFIXES):
+            continue
+        kwargs = _objective_kwargs(node)
+        name_node = kwargs.get("name")
+        obj_name = (_literal_str(name_node)
+                    if name_node is not None else None) or "?"
+        kind_node = kwargs.get("kind")
+        kind = (_literal_str(kind_node) if kind_node is not None
+                else "latency")
+        if kind == "goodput":
+            wanted = [("good_metric", "counter"),
+                      ("total_metric", "counter")]
+        elif kind == "latency":
+            wanted = [("metric", "histogram")]
+        else:
+            continue  # dynamic/unknown kind: runtime ValueError
+        for param, want_kind in wanted:
+            arg = kwargs.get(param)
+            metric = _literal_str(arg) if arg is not None else None
+            if metric is None:
+                continue  # dynamic name: left to the runtime check
+            have = _kind_of(metric, registered)
+            if have == want_kind:
+                continue
+            problem = (
+                "is not registered in obs.metrics"
+                if have is None
+                else f"is registered as a {have}, not a {want_kind}"
+            )
+            findings.append(Finding(
+                rule="slo-unbound-objective",
+                path=src.relpath, line=node.lineno,
+                message=(
+                    f"SLO objective {obj_name!r}: {param}="
+                    f"{metric!r} {problem} — a {kind} objective "
+                    f"must bind to a registered {want_kind} "
+                    "(obs/slo.py; register the family before "
+                    "declaring the objective)"
+                ),
+                key=f"{module}:{obj_name}:{metric}",
+            ))
+
+
 def check(files: list[SourceFile]) -> list[Finding]:
     hops = load_canonical_hops()
+    registered = collect_registrations(files)
     findings: list[Finding] = []
     for src in files:
         if src.tree is None:
@@ -91,6 +229,10 @@ def check(files: list[SourceFile]) -> list[Finding]:
         if src.relpath.endswith("obs/trace.py"):
             continue  # the table's own module
         aliases = _import_aliases(src.tree)
+        if not src.relpath.endswith("obs/slo.py"):
+            # (slo.py owns the dataclass; its docstrings/defaults
+            # construct no live objectives)
+            _check_objectives(src, aliases, registered, findings)
         module = src.relpath.rsplit("/", 1)[-1]
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.Call):

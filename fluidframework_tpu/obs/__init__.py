@@ -1,7 +1,8 @@
 """Cross-layer observability: op tracing, the unified metrics
-registry, and the flight recorder.
+registry, the flight recorder — and the interpretation layer on top
+of them: SLOs, the continuous profiler, and span export.
 
-Three pillars (docs/OBSERVABILITY.md):
+Pillars (docs/OBSERVABILITY.md):
 
 - ``obs.trace`` — the canonical hop table + :func:`stamp`; every
   layer stamps an op's ``traces`` list through it, so a single op's
@@ -13,6 +14,13 @@ Three pillars (docs/OBSERVABILITY.md):
   snapshots it into every stage record.
 - ``obs.flight_recorder`` — fixed-size lock-free ring of recent
   dispatch-loop / transport events, dumped automatically on faults.
+- ``obs.slo`` — declarative objectives over registry families,
+  graded with multi-window burn rates; breach dumps the recorders.
+- ``obs.profiler`` — always-on sampling host profiler with
+  per-component (thread-name) attribution + opt-in jax device-trace
+  hooks.
+- ``obs.spans`` — the hop tables as OTLP-JSON span trees for
+  standard trace viewers.
 
 This package sits just above ``protocol`` in the layer map so every
 other layer may depend on it; it depends on nothing above.
@@ -23,6 +31,9 @@ import weakref
 
 from .flight_recorder import FlightRecorder
 from .metrics import REGISTRY, MetricsRegistry, get_registry
+from .profiler import ContinuousProfiler, device_trace
+from .slo import Objective, SloEngine
+from .spans import FileSpanExporter, op_to_otlp, otlp_to_hops
 from .trace import (
     CANONICAL_HOPS,
     breakdown,
@@ -33,8 +44,10 @@ from .trace import (
 )
 
 __all__ = [
-    "CANONICAL_HOPS", "FlightRecorder", "MetricsRegistry", "REGISTRY",
-    "breakdown", "format_breakdown", "get_registry", "hop_name",
+    "CANONICAL_HOPS", "ContinuousProfiler", "FileSpanExporter",
+    "FlightRecorder", "MetricsRegistry", "Objective", "REGISTRY",
+    "SloEngine", "breakdown", "device_trace", "format_breakdown",
+    "get_registry", "hop_name", "op_to_otlp", "otlp_to_hops",
     "register_closeable", "shutdown", "stamp", "total_ms",
 ]
 

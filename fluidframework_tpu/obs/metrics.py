@@ -42,11 +42,27 @@ def _label_key(labelnames: Sequence[str], labels: dict) -> tuple:
     return tuple(str(labels[name]) for name in labelnames)
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus exposition format 0.0.4: label values escape
+    backslash, double-quote and newline."""
+    return (
+        value.replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and newline (quotes are legal)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _render_labels(labelnames: Sequence[str], key: tuple) -> str:
     if not labelnames:
         return ""
     inner = ",".join(
-        f'{name}="{value}"' for name, value in zip(labelnames, key)
+        f'{name}="{_escape_label_value(value)}"'
+        for name, value in zip(labelnames, key)
     )
     return "{" + inner + "}"
 
@@ -126,6 +142,19 @@ class Histogram:
                 )
             },
         }
+
+    def count_le(self, bound: float) -> int:
+        """Cumulative count of observations <= the LARGEST bucket
+        bound that is <= ``bound`` (exact when ``bound`` is a bucket
+        bound; conservative otherwise — the SLO engine snaps its
+        thresholds to bucket bounds so the two agree)."""
+        with _LOCK:
+            counts = list(self.counts)
+        total = 0
+        for b, c in zip(self.buckets, counts):
+            if b <= bound:
+                total += c
+        return total
 
 
 _CHILD_TYPES = {"counter": Counter, "gauge": Gauge,
@@ -237,6 +266,14 @@ class MetricsRegistry:
         return self._register(name, "histogram", help, labelnames,
                               buckets)
 
+    def get(self, name: str) -> Optional[_Family]:
+        """The registered family, or None — the SLO engine binds
+        objectives to families by name and must fail loudly on an
+        unregistered one (the runtime half of fluidlint's
+        ``slo-unbound-objective`` rule)."""
+        with _LOCK:
+            return self._families.get(name)
+
     # -- exposition ----------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -290,7 +327,9 @@ class MetricsRegistry:
             families = list(self._families.values())
         for fam in sorted(families, key=lambda f: f.name):
             if fam.help:
-                lines.append(f"# HELP {fam.name} {fam.help}")
+                lines.append(
+                    f"# HELP {fam.name} {_escape_help(fam.help)}"
+                )
             lines.append(f"# TYPE {fam.name} {fam.kind}")
             for labels, child in sorted(fam.series().items()):
                 if isinstance(child, Histogram):

@@ -24,6 +24,7 @@ code-chosen, never derived from tenant/document input.
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -43,6 +44,9 @@ _M_TIER = obs_metrics.REGISTRY.gauge(
 _M_SOURCE = obs_metrics.REGISTRY.gauge(
     "qos_pressure_source",
     "per-source normalized depth", labelnames=("source",))
+_M_TRANSITIONS = obs_metrics.REGISTRY.counter(
+    "qos_pressure_transitions_total",
+    "tier changes observed by the monitor", labelnames=("to",))
 
 
 @dataclass(frozen=True)
@@ -81,6 +85,13 @@ class PressureMonitor:
         self._sources: dict[str, tuple[Callable[[], float], float]] = {}
         self._cached: Optional[PressureReading] = None
         self._cached_at = float("-inf")
+        # tier-transition log (bounded): what the SLO report cites as
+        # overload context — "submit→ack burned through its budget
+        # WHILE pressure sat at severe" is the sentence an operator
+        # needs, and it requires the WHEN of each tier change
+        self._last_tier = TIER_NOMINAL
+        self.transitions: deque = deque(maxlen=64)
+        self.transition_counts = [0, 0, 0, 0]
 
     # ------------------------------------------------------------------
 
@@ -145,9 +156,38 @@ class PressureMonitor:
         )
         _M_PRESSURE.set(worst)
         _M_TIER.set(reading.tier)
+        if reading.tier != self._last_tier:
+            self.transitions.append(
+                (now, self._last_tier, reading.tier)
+            )
+            self.transition_counts[reading.tier] += 1
+            _M_TRANSITIONS.labels(
+                to=TIER_NAMES[reading.tier]).inc()
+            self._last_tier = reading.tier
         self._cached = reading
         self._cached_at = now
         return reading
 
     def tier(self) -> int:
         return self.sample().tier
+
+    def context(self) -> dict:
+        """Overload context for SLO reports (SloEngine.add_context):
+        current tier + the recent transition trail."""
+        reading = self.sample()
+        return {
+            "tier": reading.tier,
+            "tier_name": reading.tier_name,
+            "value": round(reading.value, 4),
+            "by_source": {
+                k: round(v, 4) for k, v in reading.by_source.items()
+            },
+            "transition_counts": {
+                TIER_NAMES[i]: c
+                for i, c in enumerate(self.transition_counts) if c
+            },
+            "recent_transitions": [
+                {"t": t, "from": TIER_NAMES[a], "to": TIER_NAMES[b]}
+                for t, a, b in list(self.transitions)[-8:]
+            ],
+        }

@@ -21,6 +21,21 @@ import uuid
 import zlib
 from typing import Any, Optional
 
+from ..obs import metrics as obs_metrics
+
+# the ledger -> histogram bridge: every acked op's per-hop deltas
+# feed ONE labelled histogram, so SLO objectives can bind to a
+# single hop's latency budget (per-hop budgets rather than one
+# end-to-end number — the collab-window framing). Label values are
+# the CANONICAL hop names (bounded vocabulary by construction).
+_HOP_MS = obs_metrics.REGISTRY.histogram(
+    "op_hop_ms",
+    "per-hop submit→ack latency attribution from the op ledger",
+    labelnames=("hop",))
+_SUBMIT_ACK_MS = obs_metrics.REGISTRY.histogram(
+    "op_submit_ack_ms",
+    "full submit→ack wall latency of ledgered ops")
+
 
 def _encode(envelope: dict) -> str:
     from ..protocol.serialization import encode_contents
@@ -182,6 +197,13 @@ class OpLatencyLedger:
             "hops": breakdown(traces),
             "total_ms": total_ms(traces),
         }
+        # ledger -> histogram bridge: the per-op record doubles as
+        # the aggregate sample (one observe per hop; hop names come
+        # from the canonical table, so the label set stays bounded)
+        for hop in entry["hops"]:
+            _HOP_MS.labels(hop=hop["hop"]).observe(hop["delta_ms"])
+        if entry["hops"]:
+            _SUBMIT_ACK_MS.observe(entry["total_ms"])
         self._entries[csn] = entry
         while len(self._entries) > self.capacity:
             self._entries.pop(next(iter(self._entries)))

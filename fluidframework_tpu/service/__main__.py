@@ -15,7 +15,13 @@ the process-wide registry (fluidframework_tpu/obs/metrics.py);
     python -m fluidframework_tpu.service --dump-metrics HOST:PORT
 
 is the /metrics-equivalent dump command (Prometheus text exposition;
-``--json`` for the structured snapshot).
+``--json`` for the structured snapshot). A service started with
+``--slo`` additionally grades the default serving objectives
+(ingress dispatch p99, goodput floor) with multi-window burn rates;
+
+    python -m fluidframework_tpu.service --dump-slo HOST:PORT
+
+prints the live ``slo_report`` (per-objective verdicts + context).
 """
 from __future__ import annotations
 
@@ -42,6 +48,27 @@ def dump_metrics(target: str, as_json: bool) -> int:
         print(json.dumps(frame["metrics"], indent=2, sort_keys=True))
     else:
         print(frame["text"], end="")
+    return 0
+
+
+def dump_slo(target: str) -> int:
+    """Connect to a running service and print its slo_report."""
+    import json
+    import socket
+
+    from .ingress import _parse_hostport, pack_frame, recv_frame_blocking
+
+    host, port = _parse_hostport(target)
+    with socket.create_connection((host, port), timeout=10.0) as sock:
+        sock.sendall(pack_frame({"type": "slo", "rid": 1}))
+        frame = recv_frame_blocking(sock)
+    if frame.get("type") != "slo":
+        print(f"unexpected response: {frame}")
+        return 1
+    if frame.get("report") is None:
+        print(frame.get("message", "no slo report"))
+        return 1
+    print(json.dumps(frame["report"], indent=2, sort_keys=True))
     return 0
 
 
@@ -75,20 +102,33 @@ def main() -> None:
                         default=2000.0,
                         help="per-connection op budget the other "
                              "qos limits scale from (default 2000)")
+    parser.add_argument("--slo", action="store_true",
+                        help="grade the default serving SLOs "
+                             "(ingress dispatch p99, goodput floor) "
+                             "with multi-window burn rates; serves "
+                             "the `slo` frame for --dump-slo")
     parser.add_argument("--dump-metrics", default=None,
                         metavar="HOST:PORT",
                         help="print a RUNNING service's metrics "
                              "registry (Prometheus text) and exit "
                              "instead of serving")
+    parser.add_argument("--dump-slo", default=None,
+                        metavar="HOST:PORT",
+                        help="print a RUNNING --slo service's "
+                             "slo_report (per-objective burn-rate "
+                             "verdicts, JSON) and exit")
     parser.add_argument("--json", action="store_true",
                         help="with --dump-metrics: emit the JSON "
                              "snapshot instead of text exposition")
     args = parser.parse_args()
     if args.dump_metrics is not None:
         raise SystemExit(dump_metrics(args.dump_metrics, args.json))
+    if args.dump_slo is not None:
+        raise SystemExit(dump_slo(args.dump_slo))
     run_server(args.host, args.port, args.data_dir, args.partitions,
                args.broker, qos_enabled=args.qos,
-               qos_ops_per_sec=args.qos_ops_per_sec)
+               qos_ops_per_sec=args.qos_ops_per_sec,
+               slo_enabled=args.slo)
 
 
 if __name__ == "__main__":

@@ -73,7 +73,7 @@ MAX_FRAME = 64 * 1024 * 1024
 # anything else counts as "unknown")
 _KNOWN_FRAME_KINDS = frozenset((
     "connect_document", "submitOp", "read_ops", "fetch_summary",
-    "upload_summary_chunk", "disconnect_document", "metrics",
+    "upload_summary_chunk", "disconnect_document", "metrics", "slo",
 ))
 _FRAMES = obs_metrics.REGISTRY.counter(
     "ingress_frames_total", "frames dispatched by the ingress",
@@ -81,6 +81,15 @@ _FRAMES = obs_metrics.REGISTRY.counter(
 _OPS_IN = obs_metrics.REGISTRY.counter(
     "ingress_ops_received_total", "raw client ops decoded (incl. "
     "boxcar members)")
+_OPS_OFFERED = obs_metrics.REGISTRY.counter(
+    "ingress_ops_offered_total",
+    "client ops offered to the ingress, shed ones included — the "
+    "denominator of the default goodput SLO")
+_OPS_TICKETED = obs_metrics.REGISTRY.counter(
+    "ingress_ops_ticketed_total",
+    "offered ops that actually reached the sequencer — the goodput "
+    "SLO's numerator (decoded-but-nacked ops must not count as "
+    "served)")
 _BOXCARS = obs_metrics.REGISTRY.counter(
     "ingress_boxcars_total", "wire-1.2 boxcarred batch submits")
 _NACKS_OUT = obs_metrics.REGISTRY.counter(
@@ -99,6 +108,10 @@ _SLOW_DISCONNECTS = obs_metrics.REGISTRY.counter(
 _OUT_DEPTH = obs_metrics.REGISTRY.gauge(
     "ingress_outbound_depth_max",
     "deepest per-session outbound queue at last sample")
+_DISPATCH_MS = obs_metrics.REGISTRY.histogram(
+    "ingress_dispatch_ms",
+    "event-loop occupancy per dispatched frame (decode + ticket + "
+    "fanout enqueue)")
 
 # Wire-protocol versions this server speaks (newest first). The
 # reference negotiates `versions` on connect_document
@@ -366,6 +379,7 @@ class AlfredServer:
                  host: str = "127.0.0.1", port: int = 0,
                  tenants: Optional[Any] = None,
                  qos: Optional[Any] = None,
+                 slo: Optional[Any] = None,
                  max_outbound_depth: Optional[int] = None,
                  outbound_drop_threshold: Optional[int] = None):
         self.local = local or LocalServer()
@@ -380,6 +394,11 @@ class AlfredServer:
         # (read_ops/fetch_summary) or the upload plane. None = the
         # open dev-service shape, like tenants=None.
         self.qos = qos
+        # optional obs.SloEngine: answers the `slo` frame and
+        # piggybacks its sampling tick on the dispatch path (the
+        # engine is passive — it only reads registry families the
+        # serving modules already bump). None = no objectives.
+        self.slo = slo
         self.max_outbound_depth = (
             max_outbound_depth or self.MAX_OUTBOUND_DEPTH
         )
@@ -609,6 +628,22 @@ class AlfredServer:
 
     def _dispatch(self, session: _ClientSession, frame: dict,
                   nbytes: int = 0) -> None:
+        """Timing shell around the frame switch: every dispatched
+        frame's event-loop occupancy lands in ``ingress_dispatch_ms``
+        (the latency the default SLO binds to), and the SLO engine's
+        rate-limited sampling tick rides the same path — a serving
+        process needs no extra timer thread to keep its burn-rate
+        windows populated."""
+        t0 = time.perf_counter()
+        try:
+            self._dispatch_frame(session, frame, nbytes)
+        finally:
+            _DISPATCH_MS.observe((time.perf_counter() - t0) * 1000.0)
+            if self.slo is not None:
+                self.slo.maybe_tick()
+
+    def _dispatch_frame(self, session: _ClientSession, frame: dict,
+                        nbytes: int = 0) -> None:
         kind = frame.get("type")
         doc = frame.get("document_id")
         _FRAMES.labels(
@@ -624,6 +659,24 @@ class AlfredServer:
                 "type": "metrics", "rid": frame.get("rid"),
                 "text": obs_metrics.REGISTRY.render_prometheus(),
                 "metrics": obs_metrics.REGISTRY.snapshot(),
+            })
+            return
+        if kind == "slo":
+            # the SLO plane's scrape point: tick + evaluate, so the
+            # report is as fresh as the ask (`--dump-slo` reads this).
+            # Unauthenticated like `metrics` — verdicts carry metric
+            # names and burn rates, never tenant content.
+            if self.slo is None:
+                session.send({
+                    "type": "slo", "rid": frame.get("rid"),
+                    "report": None,
+                    "message": "slo engine not enabled "
+                               "(start the service with --slo)",
+                })
+                return
+            session.send({
+                "type": "slo", "rid": frame.get("rid"),
+                "report": self.slo.report(),
             })
             return
         if kind == "connect_document":
@@ -726,6 +779,10 @@ class AlfredServer:
                 o.get("type") == int(MessageType.SUMMARIZE)
                 for o in ops_json
             ) else CLASS_WRITE
+            # offered counts BEFORE the gate: the goodput SLO's
+            # denominator must include what admission shed, or the
+            # objective could never see an overload
+            _OPS_OFFERED.inc(len(ops_json))
             adm = self._admit(session, klass, doc, frame,
                               ops=len(ops_json), nbytes=nbytes)
             if adm is not None:
@@ -746,6 +803,10 @@ class AlfredServer:
             for op_json, op in zip(ops_json, decoded):
                 try:
                     conn.submit(op)
+                    # goodput numerator: only ops the sequencer
+                    # actually accepted — counting at decode would
+                    # read an all-nacked fleet as 100% served
+                    _OPS_TICKETED.inc()
                 except PermissionError as e:
                     # read-mode connection: reject as a NACK so the
                     # driver's on_nack fires (parity with the in-proc
@@ -932,6 +993,32 @@ class AlfredServer:
         })
 
 
+def default_slo_objectives() -> list:
+    """The service plane's default objectives (docs/OBSERVABILITY.md
+    "Serving SLOs"). They bind ONLY to families this module owns —
+    obs must never import what it observes, so the objective
+    declarations live with the layer that registers the histograms:
+
+    - ``ingress-dispatch-p99``: 99% of dispatched frames occupy the
+      event loop < 50ms. The loop IS the serving capacity of this
+      process; a frame past 50ms is starving every other session.
+    - ``ingress-goodput``: >= 95% of offered client ops decode and
+      ticket (the rest were shed by admission or failed) over the
+      burn window — the "is the service actually serving" floor.
+    """
+    from ..obs.slo import Objective
+
+    return [
+        Objective("ingress-dispatch-p99",
+                  metric="ingress_dispatch_ms",
+                  threshold_ms=50.0, target=0.99),
+        Objective("ingress-goodput", kind="goodput",
+                  good_metric="ingress_ops_ticketed_total",
+                  total_metric="ingress_ops_offered_total",
+                  target=0.95),
+    ]
+
+
 def _parse_hostport(value: str, default_host: str = "127.0.0.1"
                     ) -> tuple[str, int]:
     """Parse "host:port" (IPv6 literals bracketed: "[::1]:7081") with
@@ -1004,7 +1091,8 @@ def run_server(host: str = "127.0.0.1", port: int = 7070,
                partitions: int = 0,
                broker: Optional[str] = None,
                qos_enabled: bool = False,
-               qos_ops_per_sec: float = 2000.0) -> None:
+               qos_ops_per_sec: float = 2000.0,
+               slo_enabled: bool = False) -> None:
     """Blocking entry point (the tinylicious analogue; see
     service/__main__.py). ``data_dir`` makes every document durable:
     op log, summaries and deli checkpoints survive restarts.
@@ -1016,7 +1104,9 @@ def run_server(host: str = "127.0.0.1", port: int = 7070,
     ``qos_enabled`` turns on admission control + backpressure
     (docs/QOS.md): token-bucket limits scaled from
     ``qos_ops_per_sec``, pressure-tier shedding, and a circuit
-    breaker around checkpoint writes."""
+    breaker around checkpoint writes. ``slo_enabled`` attaches the
+    default serving objectives (:func:`default_slo_objectives`) to
+    an obs.SloEngine serving the ``slo`` frame / ``--dump-slo``."""
     queue = None
     if broker is not None:
         from .broker import RemoteOrderingQueue
@@ -1090,7 +1180,18 @@ def run_server(host: str = "127.0.0.1", port: int = 7070,
     else:
         local = LocalServer(durable_dir=data_dir,
                             storage_breaker=storage_breaker)
-    server = AlfredServer(local, host=host, port=port, qos=qos)
+    slo = None
+    if slo_enabled:
+        from ..obs.slo import SloEngine
+
+        slo = SloEngine(default_slo_objectives())
+        if qos is not None and getattr(qos, "pressure", None) \
+                is not None:
+            # burn-rate verdicts cite the overload context: "goodput
+            # burned through its budget WHILE pressure sat at severe"
+            slo.add_context("pressure", qos.pressure.context)
+    server = AlfredServer(local, host=host, port=port, qos=qos,
+                          slo=slo)
 
     async def main():
         await server.start()

@@ -53,6 +53,7 @@ import numpy as np
 from ..models.mergetree import MergeTreeClient
 from ..obs import metrics as obs_metrics
 from ..obs.flight_recorder import FlightRecorder
+from ..obs.profiler import device_trace
 from ..obs.trace import stamp as trace_stamp
 from ..ops import (
     DocStream,
@@ -813,9 +814,14 @@ class TpuMergeSidecar:
         if self.trace_ops:
             self._inflight_msgs = self._round_msgs
             self._round_msgs = []
-        self._table = self._apply_program(
-            self._prev_table, program, dead if self.donate else None
-        )
+        # opt-in device-trace annotation (FFTPU_DEVICE_TRACE=1): the
+        # dispatch window shows up by round in an XLA profiler trace;
+        # disabled it is one env lookup, and either way it forces no
+        # host<->device sync (the settle boundary stays the only one)
+        with device_trace(f"sidecar:dispatch:r{self.stats['rounds']}"):
+            self._table = self._apply_program(
+                self._prev_table, program, dead if self.donate else None
+            )
         return real + pool_real
 
     def _settle(self) -> None:

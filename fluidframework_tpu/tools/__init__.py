@@ -12,6 +12,11 @@ from .benchmark import (
 )
 from .fluid_runner import export_content, export_file
 from .replay_tool import ReplayReport, replay_document, replay_file
+from .serve_bench import (
+    ServeBenchConfig,
+    ServeBenchReport,
+    run_serve_bench,
+)
 from .stress import StressConfig, StressReport, run_stress
 
 __all__ = [
@@ -19,6 +24,8 @@ __all__ = [
     "BenchmarkResult",
     "BenchmarkType",
     "ReplayReport",
+    "ServeBenchConfig",
+    "ServeBenchReport",
     "StressConfig",
     "StressReport",
     "benchmark",
@@ -26,5 +33,6 @@ __all__ = [
     "export_file",
     "replay_document",
     "replay_file",
+    "run_serve_bench",
     "run_stress",
 ]

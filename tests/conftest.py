@@ -69,14 +69,15 @@ def alfred(monkeypatch):
 
     state = {}
 
-    def start(tenants=None, server_versions=None, qos=None):
+    def start(tenants=None, server_versions=None, qos=None,
+              slo=None):
         from fluidframework_tpu.service import ingress as ingress_mod
         from fluidframework_tpu.service.ingress import AlfredServer
 
         if server_versions is not None:
             monkeypatch.setattr(
                 ingress_mod, "WIRE_VERSIONS", tuple(server_versions))
-        server = AlfredServer(tenants=tenants, qos=qos)
+        server = AlfredServer(tenants=tenants, qos=qos, slo=slo)
         loop = asyncio.new_event_loop()
         started = threading.Event()
 

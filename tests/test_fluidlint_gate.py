@@ -116,6 +116,75 @@ def test_obscheck_family_is_in_the_gate():
     assert "obscheck" in core.FAMILIES
 
 
+def test_slo_unbound_objective_rule_fires_on_unregistered_metric(
+        tmp_path):
+    """The slo-unbound-objective rule (obscheck family): a declared
+    Objective whose metric literal names no registered family — or a
+    family of the wrong kind — fails; objectives bound to families
+    registered anywhere in the scanned tree pass; dynamic names are
+    the runtime ValueError's job."""
+    fixture = tmp_path / "objectives.py"
+    fixture.write_text(
+        "from fluidframework_tpu.obs.slo import Objective\n"
+        "from fluidframework_tpu.obs import metrics as obs_metrics\n"
+        "H = obs_metrics.REGISTRY.histogram('fix_lat_ms', 'h')\n"
+        "C = obs_metrics.REGISTRY.counter('fix_good_total', 'c')\n"
+        "T = obs_metrics.REGISTRY.counter('fix_total_total', 'c')\n"
+        "G = obs_metrics.REGISTRY.gauge('fix_depth', 'g')\n"
+        "OK1 = Objective('lat', metric='fix_lat_ms',\n"
+        "                threshold_ms=5.0)\n"
+        "OK2 = Objective('gp', kind='goodput',\n"
+        "                good_metric='fix_good_total',\n"
+        "                total_metric='fix_total_total')\n"
+        "BAD1 = Objective('ghost', metric='fix_nonexistent_ms')\n"
+        "BAD2 = Objective('wrongkind', metric='fix_good_total')\n"
+        "BAD3 = Objective('gpbad', kind='goodput',\n"
+        "                 good_metric='fix_depth',\n"
+        "                 total_metric='fix_total_total')\n"
+        "name = 'dyn_ms'\n"
+        "DYN = Objective('dyn', metric=name)\n"  # runtime's job
+    )
+    findings = core.run_analysis(
+        roots=[str(fixture)], families=["obscheck"],
+    )
+    assert sorted(f.key for f in findings) == [
+        "objectives.py:ghost:fix_nonexistent_ms",
+        "objectives.py:gpbad:fix_depth",
+        "objectives.py:wrongkind:fix_good_total",
+    ]
+    assert all(f.rule == "slo-unbound-objective" for f in findings)
+
+    # partial-path scans fall back to the real package's registered
+    # families: an objective bound to a family registered OUTSIDE the
+    # scanned files (here: the sidecar's settle histogram and the
+    # ingress goodput counters) must stay clean
+    partial = tmp_path / "partial.py"
+    partial.write_text(
+        "from fluidframework_tpu.obs.slo import Objective\n"
+        "A = Objective('settle', metric='sidecar_settle_ms',\n"
+        "              threshold_ms=100.0)\n"
+        "B = Objective('gp', kind='goodput',\n"
+        "              good_metric='ingress_ops_received_total',\n"
+        "              total_metric='ingress_ops_offered_total')\n"
+    )
+    assert core.run_analysis(
+        roots=[str(partial)], families=["obscheck"],
+    ) == []
+
+    # a module's own unrelated Objective class (no obs import) is
+    # not the rule's business
+    own = tmp_path / "own.py"
+    own.write_text(
+        "class Objective:\n"
+        "    def __init__(self, *a, **k):\n"
+        "        pass\n"
+        "X = Objective('x', metric='definitely_not_registered')\n"
+    )
+    assert core.run_analysis(
+        roots=[str(own)], families=["obscheck"],
+    ) == []
+
+
 def test_service_unbounded_queue_rule_fires_in_service_paths(
         tmp_path):
     """The service-unbounded-queue rule (qoscheck family): an
@@ -187,6 +256,7 @@ def test_family_rules_map_stays_complete():
     assert set(core.FAMILY_RULES) == set(core.FAMILIES)
     for rule in ("layer-undeclared", "jit-nondeterminism",
                  "lock-unlocked-write", "obs-untimed-hop",
+                 "slo-unbound-objective",
                  "service-unbounded-queue", "lock-order-cycle",
                  "async-blocking-call", "await-holding-lock",
                  "dispatch-loop-sync"):
