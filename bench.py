@@ -1904,6 +1904,22 @@ def _registry_snapshot() -> dict | None:
         return None
 
 
+def _jax_compiles() -> dict | None:
+    """Per-root XLA compile counts for this stage's process — the
+    jitsan cache-size probe (testing/jitsan.py), which also advances
+    ``jax_compiles_total{root}`` in the registry snapshot above. A
+    recompile regression (an unladdered shape sneaking onto the
+    serving path) shows up as a BENCH_* delta here, not just in the
+    fluidlint gate. None if the probe fails (best-effort, like the
+    lint counts)."""
+    try:
+        from fluidframework_tpu.testing import jitsan
+
+        return jitsan.publish_compiles()
+    except Exception:  # noqa: BLE001 - counts are best-effort
+        return None
+
+
 def run_stage(name: str, backend: str, scale: str, reps: int,
               cooldown: float, out_path: str | None) -> None:
     _stage_env_setup(backend)
@@ -1911,6 +1927,9 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
 
     t0 = time.perf_counter()
     result = STAGE_FNS[name](scale, reps, cooldown)
+    # probe BEFORE the registry snapshot so the jax_compiles_total
+    # counter it advances is visible in metrics_registry too
+    jax_compiles = _jax_compiles()
     result.update({
         "backend": jax.default_backend(),
         "scale": scale,
@@ -1922,6 +1941,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         # free because each stage runs in its own subprocess
         "metrics_registry": _registry_snapshot(),
         "fluidlint_findings": _fluidlint_counts(),
+        "jax_compiles": jax_compiles,
     })
     # persist the full-scale result BEFORE the fixed-scale companion:
     # if the companion pushes the child past the subprocess timeout,
@@ -1942,6 +1962,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         fixed = STAGE_FNS[name]("cpu", max(1, reps // 2), 0.5)
         fixed["corpus"] = STAGE_CORPUS.get(name)
         fixed["stage_elapsed_s"] = round(time.perf_counter() - t1, 1)
+        fixed["jax_compiles"] = _jax_compiles()
         fixed["metrics_registry"] = _registry_snapshot()
         fixed["fluidlint_findings"] = _fluidlint_counts()
         result["fixed_scale"] = fixed

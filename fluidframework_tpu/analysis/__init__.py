@@ -8,7 +8,7 @@ invariant families the merge-engine work actually breaks in practice
 (round-5 advisor findings): JAX tracing hazards inside kernels and
 lock discipline around cross-thread state.
 
-Six pass families, one CLI (``python -m fluidframework_tpu.analysis``):
+Seven pass families, one CLI (``python -m fluidframework_tpu.analysis``):
 
 - **layercheck** — resolves absolute and relative imports into a
   module graph and enforces the declared layer architecture
@@ -31,6 +31,16 @@ Six pass families, one CLI (``python -m fluidframework_tpu.analysis``):
   awaits holding threading locks. Cross-checked at runtime by the
   fluidsan lockset sanitizer (testing/sanitizer.py): runtime-observed
   lock-order edges must stay a subset of the static graph.
+- **shapecheck** — abstract shape/dtype/donation analysis over the
+  kernel layer (analysis/shapecheck.py): donated-buffer dataflow
+  (read-after-donation), the bucket-ladder-only shape-source
+  invariant (recompile storms), 64-bit dtype widening inside
+  jit-reachable kernels, operand shape mismatches, and
+  prewarm-coverage of every dispatch-reachable jit root.
+  Cross-checked at runtime by the jitsan compile-count & donation
+  sanitizer (testing/jitsan.py): observed compile counts per root
+  must stay within the static ladder bounds, and the abstract
+  interpreter's output signatures must equal ``jax.eval_shape``.
 
 Findings are ``path:line: rule-id message``; suppressible per line
 with ``# fluidlint: disable=<rule-id>[,<rule-id>...]`` and

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 
 from .core import (
@@ -21,6 +23,42 @@ from .core import (
     load_allowlist,
     run_analysis,
 )
+
+
+def changed_files(ref: str, repo_root: str = REPO_ROOT) -> list[str]:
+    """Python files touched vs ``ref`` (committed diff + staged +
+    working tree + untracked), repo-root-relative, existing only —
+    the fast-local-iteration scan set for ``--changed``."""
+    out: set[str] = set()
+    for args in (
+        ["git", "diff", "--name-only", ref, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                args, capture_output=True, text=True, cwd=repo_root,
+                timeout=30,
+            )
+        except subprocess.TimeoutExpired as e:
+            # the documented CLI failure contract is `error: ...` +
+            # exit 2, not a raw traceback
+            raise ValueError(
+                f"git timed out for {' '.join(args)!r}"
+            ) from e
+        if proc.returncode != 0:
+            raise ValueError(
+                f"git failed for {' '.join(args)!r}: "
+                f"{proc.stderr.strip()}"
+            )
+        out.update(
+            line.strip() for line in proc.stdout.splitlines()
+            if line.strip()
+        )
+    return sorted(
+        p for p in out
+        if p.endswith(".py")
+        and os.path.exists(os.path.join(repo_root, p))
+    )
 
 
 def to_sarif(findings, stale) -> dict:
@@ -83,12 +121,19 @@ def to_sarif(findings, stale) -> dict:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m fluidframework_tpu.analysis",
-        description="fluidlint: layercheck + jaxhazards + lockcheck "
-                    "+ obscheck + qoscheck + concheck",
+        description="fluidlint: " + " + ".join(FAMILIES),
     )
     parser.add_argument(
         "paths", nargs="*", default=None,
         help="files/directories to scan (default: the repo tree)",
+    )
+    parser.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None,
+        metavar="REF",
+        help="scan only python files touched vs a git ref (default "
+             "HEAD when the flag is bare) — fast local iteration "
+             "before the full tier-1 gate run; allowlist staleness "
+             "is skipped like any partial-path scan",
     )
     parser.add_argument(
         "--rules", default=",".join(FAMILIES),
@@ -115,12 +160,31 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     families = [f for f in args.rules.split(",") if f]
+    partial_scan = bool(args.paths)
     try:
+        if args.changed is not None:
+            if args.paths:
+                raise ValueError(
+                    "--changed and explicit paths are mutually "
+                    "exclusive"
+                )
+            roots = changed_files(args.changed, REPO_ROOT)
+            partial_scan = True
+            if not roots:
+                # still fall through to the output stage: a docs-only
+                # diff under --sarif/--json must emit a valid empty
+                # report, not zero bytes of stdout
+                print(
+                    f"fluidlint: no python files changed vs "
+                    f"{args.changed}", file=sys.stderr,
+                )
+        else:
+            roots = args.paths or DEFAULT_ROOTS
         findings = run_analysis(
-            roots=args.paths or DEFAULT_ROOTS,
+            roots=roots,
             families=families,
             repo_root=REPO_ROOT,
-        )
+        ) if roots else []
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -130,11 +194,11 @@ def main(argv=None) -> int:
     )
     kept, stale = apply_allowlist(findings, allowlist)
     n_allowed = len(findings) - len(kept)
-    if args.paths:
-        # a partial-path scan legitimately misses allowlisted
-        # findings elsewhere in the tree; staleness is only
-        # meaningful (and only enforced, here and in the gate test)
-        # on a full default-roots run
+    if partial_scan:
+        # a partial-path scan (explicit paths or --changed)
+        # legitimately misses allowlisted findings elsewhere in the
+        # tree; staleness is only meaningful (and only enforced, here
+        # and in the gate test) on a full default-roots run
         stale = []
 
     if args.as_sarif:

@@ -251,12 +251,29 @@ class CallGraph:
                             target, ref[2], method, _seen))
         return out
 
-    def _lookup_symbol(self, mod: _Module, name: str) -> list:
+    def _lookup_symbol(self, mod: _Module, name: str,
+                       _seen=None) -> list:
         """Module-level function (or class -> __init__) named
-        ``name`` in ``mod``."""
+        ``name`` in ``mod``. When the module holds no such def but
+        RE-EXPORTS the name (``from .merge_kernel import compact`` in
+        a package __init__), the chain is chased — the facade import
+        (``from ..ops import compact``) used to silently drop the
+        edge, which is exactly how the sidecar's kernel entry points
+        hid from prewarm-coverage."""
         out = list(mod.functions.get(name, []))
         if name in mod.classes:
             out.extend(mod.classes[name].get("__init__", []))
+        if out:
+            return out
+        _seen = _seen or set()
+        if (mod.src.relpath, name) in _seen:
+            return []
+        _seen.add((mod.src.relpath, name))
+        ref = mod.imports.get(name)
+        if ref is not None and ref[0] == "symbol":
+            target = self._modules.get(ref[1])
+            if target is not None:
+                return self._lookup_symbol(target, ref[2], _seen)
         return out
 
     def resolve_call(self, call: ast.Call,

@@ -200,7 +200,7 @@ def load_allowlist(path: str = ALLOWLIST_PATH) -> list[tuple[str, str]]:
 
 
 FAMILIES = ("layercheck", "jaxhazards", "lockcheck", "obscheck",
-            "qoscheck", "concheck")
+            "qoscheck", "concheck", "shapecheck")
 
 # rule id -> owning family: tooling that groups ONE combined run's
 # findings per family (bench's fluidlint_findings records) reads
@@ -216,6 +216,9 @@ FAMILY_RULES = {
     "qoscheck": ("service-unbounded-queue",),
     "concheck": ("lock-order-cycle", "async-blocking-call",
                  "await-holding-lock"),
+    "shapecheck": ("donated-buffer-reuse", "unladdered-jit-shape",
+                   "kernel-dtype-widen", "shape-mismatch",
+                   "prewarm-coverage"),
 }
 RULE_FAMILY = {
     rule: fam for fam, rules in FAMILY_RULES.items() for rule in rules
@@ -236,6 +239,7 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
         lockcheck,
         obscheck,
         qoscheck,
+        shapecheck,
     )
 
     passes = {
@@ -245,6 +249,7 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
         "obscheck": obscheck.check,
         "qoscheck": qoscheck.check,
         "concheck": concurrency.check,
+        "shapecheck": shapecheck.check,
     }
     unknown = [f for f in families if f not in passes]
     if unknown:
@@ -254,16 +259,17 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
     files = walk_python_files(roots, repo_root)
     findings: list[Finding] = []
     by_path = {f.relpath: f for f in files}
-    # one shared call graph per run: jaxhazards and concheck resolve
-    # through the same interprocedural edges (and pay for the build
-    # once)
+    # one shared call graph per run: jaxhazards, concheck and
+    # shapecheck resolve through the same interprocedural edges (and
+    # pay for the build once)
+    GRAPH_FAMILIES = ("jaxhazards", "concheck", "shapecheck")
     shared_graph = None
-    if {"jaxhazards", "concheck"} & set(families):
+    if set(GRAPH_FAMILIES) & set(families):
         from .callgraph import build_callgraph
 
         shared_graph = build_callgraph(files)
     for fam in families:
-        if fam in ("jaxhazards", "concheck"):
+        if fam in GRAPH_FAMILIES:
             findings.extend(passes[fam](files, graph=shared_graph))
         else:
             findings.extend(passes[fam](files))

@@ -371,6 +371,40 @@ class SeqShardedPool:
         _M_POOL_WATERMARK.set(sum(self.applied_upto.values()))
         return self.overflowed_slots()
 
+    def prewarm(self) -> None:
+        """Compile the pool's dispatch programs before any admission:
+        the first-admission table (row bucket 1, pool capacity) at
+        both window shapes the pool dispatches — the incremental
+        ``dispatch_pending`` floor bucket and the ``_replay_all``
+        chunk bucket — plus the compact that follows every pool
+        dispatch. This covers the COMMON first overflow recovery (one
+        slot overflows a settle, its tail stays under the floor
+        bucket), which used to stall the settle boundary 20-40s on
+        the real chip. Shapes beyond that still compile on admission
+        and are unbounded by construction: a multi-slot same-settle
+        admission builds a wider row bucket, a pending tail past the
+        floor packs a higher window bucket, and later pow2
+        member-growth rebuilds each have their own signature —
+        admission is rare and already O(history), so those pay as
+        they land (shapecheck's prewarm-coverage rule pins the
+        ROOTS reachable, not every shape)."""
+        noop = dict(
+            kind=KIND_NOOP, pos1=0, pos2=0, seq=0, refseq=0,
+            client=0, op_id=0, length=0, is_marker=0,
+            prop_key=0, prop_val=0, min_seq=0,
+        )
+        chunk = max(16, min(256, self.capacity // 4))
+        for floor in sorted({16, chunk}):
+            arrays = _pack_rows(1, {0: [noop]}, bucket_floor=floor)
+            # each floor needs BOTH input signatures: a fresh
+            # make_table (the first _replay_all chunk) and a table
+            # that came out of a pool dispatch, which carries the
+            # mesh's committed sharding — a distinct jit signature
+            # the single-apply prewarm missed (every incremental
+            # dispatch_pending after admission uses it)
+            out = self._apply(make_table(1, self.capacity), arrays)
+            self._apply(out, arrays)
+
     def overflowed_slots(self) -> list:
         if self._table is None:
             return []
@@ -706,8 +740,17 @@ class TpuMergeSidecar:
             if dummy_prev is not None:
                 pad_capacity(dummy_prev, rung)
             dummy_prev = table
+        if self._pool is not None:
+            self._warm_pool()
         np.asarray(table.count)  # force completion
         return time.perf_counter() - t0
+
+    def _warm_pool(self) -> None:
+        """Walk the pool tier's dispatch programs (see
+        ``SeqShardedPool.prewarm``) — reached through the attribute-
+        held pool, so the edge is declared in
+        shapecheck.PREWARM_INDIRECT."""
+        self._pool.prewarm()
 
     def _compile_program(self, arrays: dict) -> dict:
         """Host half of one dispatch: raw packed arrays for the scan

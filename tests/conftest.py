@@ -32,12 +32,16 @@ import pytest  # noqa: E402
 # threading.Lock/RLock created during the session with the lockset
 # sanitizer. Installed at conftest import — BEFORE test modules
 # import — so locks created at test-module import time are wrapped
-# too. The autouse guard below fails any test that trips it.
+# too. jitsan (testing/jitsan.py) rides the same guard: it baselines
+# the kernel jit caches and arms the donation read-traps. The autouse
+# guard below fails any test that trips either.
 _SANITIZE = os.environ.get("FFTPU_SANITIZE") == "1"
 if _SANITIZE:
+    from fluidframework_tpu.testing import jitsan as _jitsan
     from fluidframework_tpu.testing import sanitizer as _fluidsan
 
     _fluidsan.install()
+    _jitsan.install()
 
 
 @pytest.fixture(autouse=True)
@@ -45,9 +49,10 @@ def _fluidsan_trip_guard():
     if not _SANITIZE:
         yield
         return
-    from fluidframework_tpu.testing import sanitizer
+    from fluidframework_tpu.testing import jitsan, sanitizer
 
     before = len(sanitizer.trips())
+    before_jit = len(jitsan.trips())
     yield
     fresh = sanitizer.trips()[before:]
     if fresh:
@@ -55,6 +60,12 @@ def _fluidsan_trip_guard():
             "fluidsan tripped during this test:\n"
             + "\n".join(t.describe() for t in fresh)
             + "\n" + fresh[0].flight_dump
+        )
+    fresh_jit = jitsan.trips()[before_jit:]
+    if fresh_jit:
+        pytest.fail(
+            "jitsan tripped during this test:\n"
+            + "\n".join(t.describe() for t in fresh_jit)
         )
 
 

@@ -1041,6 +1041,704 @@ def test_callgraph_resolves_deep_dotted_chains_through_packages(
     assert [f.key for f in findings] == ["util.py:slow:time.sleep"]
 
 
+# ---------------------------------------------------------------- shapecheck
+
+def test_shapecheck_flags_read_after_donation(tmp_path):
+    """donated-buffer-reuse, the dataflow form: a value donated to a
+    jit must not be read on any later path. Tail calls and rebinding
+    (the sidecar's rotate idiom) are the sanctioned shapes."""
+    findings = _lint(tmp_path, {
+        "ops/kern.py": """
+            import jax
+
+            def impl(dead, batch):
+                return batch
+
+            pingpong = jax.jit(impl, donate_argnums=(0,))
+
+            def bad_dispatch(fodder, batch):
+                out = pingpong(fodder, batch)
+                return out, fodder.length       # read after donation
+
+            def good_tail(fodder, batch):
+                return pingpong(fodder, batch)  # ok: nothing follows
+
+            def good_rotate(fodder, batch):
+                fodder = pingpong(fodder, batch)  # ok: rebound
+                return fodder.length
+        """,
+    }, families=["shapecheck"])
+    assert [f.key for f in findings
+            if f.rule == "donated-buffer-reuse"] == [
+        "kern.py:bad_dispatch:fodder",
+    ]
+    hit = findings[0]
+    assert "read after being donated" in hit.message
+
+
+def test_shapecheck_flags_donating_the_live_input(tmp_path):
+    """The aliasing form: one name passed both donated and live in
+    the same dispatch (XLA may back the output with buffers the
+    kernel still reads) — flagged even with no read afterward."""
+    findings = _lint(tmp_path, {
+        "ops/kern.py": """
+            import jax
+
+            def impl(dead, batch):
+                return batch
+
+            pingpong = jax.jit(impl, donate_argnums=(0,))
+
+            def serve(table, batch):
+                return pingpong(table, table)
+        """,
+    }, families=["shapecheck"])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("donated-buffer-reuse", "kern.py:serve:table"),
+    ]
+    assert "both as a DONATED argument and as a live input" in \
+        findings[0].message
+
+
+def test_shapecheck_donation_propagates_through_wrappers(tmp_path):
+    """Interprocedural: a wrapper that forwards a param into a
+    donating jit makes that param donated at every call site of the
+    wrapper (the sidecar's _apply_program shape)."""
+    findings = _lint(tmp_path, {
+        "ops/wrap.py": """
+            import jax
+
+            def impl(dead, batch):
+                return batch
+
+            pingpong = jax.jit(impl, donate_argnums=(0,))
+
+            def rotate(fodder, batch):
+                return pingpong(fodder, batch)
+
+            def serve(old, batch):
+                out = rotate(old, batch)
+                return out, old.count
+        """,
+    }, families=["shapecheck"])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("donated-buffer-reuse", "wrap.py:serve:old"),
+    ]
+
+
+def test_shapecheck_donation_factory_and_fresh_constructor(tmp_path):
+    """The `_get_jit(K)(dead, ...)` call-of-call through a jit
+    factory donates too; a FRESH_CONSTRUCTORS result (make_table) is
+    never an alias of the names feeding it."""
+    findings = _lint(tmp_path, {
+        "ops/fact.py": """
+            import jax
+
+            _cache = {}
+
+            def _get(k):
+                fn = _cache.get(k)
+                if fn is None:
+                    fn = jax.jit(lambda d, b: b, donate_argnums=(0,))
+                    _cache[k] = fn
+                return fn
+
+            def make_table(docs, capacity):
+                return docs
+
+            def serve(old, batch):
+                out = _get(4)(old, batch)
+                return out, old.count
+
+            def fresh(batch):
+                docs = 3
+                out = _get(4)(make_table(docs, 64), batch)
+                return out, docs            # ok: fresh result donated
+        """,
+    }, families=["shapecheck"])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("donated-buffer-reuse", "fact.py:serve:old"),
+    ]
+
+
+def test_shapecheck_donation_sees_try_except_finally_paths(tmp_path):
+    """Handler bodies and finally blocks are post-call paths: an
+    exception after the donating dispatch lands in the handler with
+    the buffer already consumed, and finally runs even after
+    ``return pingpong(dead, ...)``. A handler that never touches the
+    donated name stays clean."""
+    findings = _lint(tmp_path, {
+        "ops/kern.py": """
+            import jax
+
+            def impl(dead, batch):
+                return batch
+
+            pingpong = jax.jit(impl, donate_argnums=(0,))
+
+            def log(x):
+                return x
+
+            def handler_read(fodder, batch):
+                try:
+                    out = pingpong(fodder, batch)
+                    log(out)
+                except ValueError:
+                    return fodder.length
+                return out
+
+            def finally_read(fodder, batch):
+                try:
+                    return pingpong(fodder, batch)
+                finally:
+                    log(fodder.count)
+
+            def handler_clean(fodder, batch):
+                try:
+                    return pingpong(fodder, batch)
+                except ValueError:
+                    return None
+        """,
+    }, families=["shapecheck"])
+    assert sorted(f.key for f in findings) == [
+        "kern.py:finally_read:fodder",
+        "kern.py:handler_read:fodder",
+    ]
+    assert all(f.rule == "donated-buffer-reuse" for f in findings)
+
+
+def test_shapecheck_keyword_live_input_aliasing_flagged(tmp_path):
+    """Donating a value that also rides in BY KEYWORD is the same
+    aliasing bug as the positional form."""
+    findings = _lint(tmp_path, {
+        "ops/kern.py": """
+            import jax
+
+            def impl(dead, table, batch):
+                return table
+
+            pingpong = jax.jit(impl, donate_argnums=(0,))
+
+            def serve(t, batch):
+                return pingpong(t, table=t, batch=batch)
+        """,
+    }, families=["shapecheck"])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("donated-buffer-reuse", "kern.py:serve:t"),
+    ]
+
+
+def test_shapecheck_donation_suppressible_inline(tmp_path):
+    findings = _lint(tmp_path, {
+        "ops/kern.py": """
+            import jax
+
+            def impl(dead, batch):
+                return batch
+
+            pingpong = jax.jit(impl, donate_argnums=(0,))
+
+            def trap_test(fodder, batch):
+                out = pingpong(fodder, batch)
+                return out, fodder.length  # fluidlint: disable=donated-buffer-reuse -- deliberate trap read
+        """,
+    }, families=["shapecheck"])
+    assert findings == []
+
+
+def test_shapecheck_flags_unladdered_jit_shape(tmp_path):
+    """unladdered-jit-shape: a shape-determining argument that does
+    not flow from the BucketLadder (or a static_argnums slot) in a
+    kernel-layer path is a potential recompile storm; ladder-derived
+    and static-slotted calls pass, and non-kernel paths are out of
+    scope."""
+    kernel = """
+        import jax
+        import numpy as np
+
+        from fluidframework_tpu.ops.bucket_ladder import BucketLadder
+
+        def impl(batch):
+            return batch
+
+        step = jax.jit(impl)
+        sized = jax.jit(impl, static_argnums=(0,))
+
+        WINDOW = 37
+
+        def bad(ops):
+            batch = np.zeros(WINDOW)
+            return step(batch)
+
+        def good_laddered(ops):
+            ladder = BucketLadder(16, 64)
+            batch = np.zeros(ladder.bucket(len(ops)))
+            return step(batch)
+
+        def good_static():
+            return sized(WINDOW)
+    """
+    findings = _lint(tmp_path, {"ops/serve.py": kernel},
+                     families=["shapecheck"])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("unladdered-jit-shape", "serve.py:bad:step[0]"),
+    ]
+    assert "BucketLadder" in findings[0].message
+    # the same code outside the kernel layer (ops/parallel/service/
+    # tools path components) is not the rule's business: tests and
+    # bench dispatch deliberately exact-fit shapes
+    assert _lint(tmp_path / "elsewhere", {"lib/serve.py": kernel},
+                 families=["shapecheck"]) == []
+
+
+def test_shapecheck_flags_dtype_widen_in_jit_reachable_kernel(
+        tmp_path):
+    """kernel-dtype-widen: a 64-bit cast/construction inside a
+    jit-reachable body (directly or through a helper) doubles HBM;
+    host-only helpers are out of scope."""
+    findings = _lint(tmp_path, {
+        "ops/k.py": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                return _mix(x)
+
+            def _mix(x):
+                wide = x.astype(jnp.int64)
+                acc = jnp.zeros(4, dtype=jnp.float64)
+                weak = x.astype(int)
+                return wide + acc + weak
+
+            def host_only(x):
+                return x.astype(jnp.int64)   # ok: never jit-traced
+        """,
+    }, families=["shapecheck"])
+    assert sorted(f.key for f in findings) == [
+        "k.py:_mix:float64",
+        "k.py:_mix:int",
+        "k.py:_mix:int64",
+    ]
+    assert all(f.rule == "kernel-dtype-widen" for f in findings)
+
+
+def test_shapecheck_plain_int_float_calls_are_not_widens(tmp_path):
+    """The bare int()/float() builtins only widen in DTYPE positions
+    (astype(int), dtype=float): a plain ``int(x)`` call is host-side
+    scalar arithmetic — flagging it would fail the gate on idiomatic
+    shape math."""
+    findings = _lint(tmp_path, {
+        "ops/k.py": """
+            import jax
+
+            @jax.jit
+            def step(x):
+                n = int(4)
+                scale = float(n)
+                return x * scale
+        """,
+    }, families=["shapecheck"])
+    assert findings == []
+
+
+def test_shapecheck_dtype_widen_keys_distinguish_same_named_methods(
+        tmp_path):
+    """Two classes in one module with same-named jit methods must not
+    collapse onto one dedup/allowlist key (the concheck qualname
+    precedent)."""
+    findings = _lint(tmp_path, {
+        "ops/k.py": """
+            import jax
+            import jax.numpy as jnp
+
+            class A:
+                @jax.jit
+                def step(self, x):
+                    return x.astype(jnp.int64)
+
+            class B:
+                @jax.jit
+                def step(self, x):
+                    return x.astype(jnp.int64)
+        """,
+    }, families=["shapecheck"])
+    assert sorted(f.key for f in findings
+                  if f.rule == "kernel-dtype-widen") == [
+        "k.py:A.step:int64",
+        "k.py:B.step:int64",
+    ]
+
+
+def test_shapecheck_flags_shape_mismatch(tmp_path):
+    """shape-mismatch: inferred operand shapes of concat/where
+    disagree off the concatenation axis / across broadcasting."""
+    findings = _lint(tmp_path, {
+        "ops/m.py": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                a = jnp.zeros((4, 8), dtype=jnp.int32)
+                b = jnp.ones((4, 9), dtype=jnp.int32)
+                cat = jnp.concatenate([a, b], axis=0)  # 8 vs 9 off-axis
+                ok = jnp.concatenate([a, b], axis=1)   # ok: on the axis
+                sel = jnp.where(x > 0, jnp.zeros((4, 8)),
+                                jnp.ones((4, 7)))      # no broadcast
+                return cat, ok, sel
+        """,
+    }, families=["shapecheck"])
+    assert sorted(f.key for f in findings) == [
+        "m.py:step:concatenate:ax1:8v9",
+        "m.py:step:where:8v7",
+    ]
+    assert all(f.rule == "shape-mismatch" for f in findings)
+
+
+def test_shapecheck_concat_positional_axis(tmp_path):
+    """The concat axis arrives positionally too —
+    ``jnp.concatenate(ops, 1)`` is valid jax; treating it as axis 0
+    would flag correct code. A non-literal axis skips the per-axis
+    comparison (cannot know which dim is exempt) but keeps the rank
+    check."""
+    findings = _lint(tmp_path, {
+        "ops/m.py": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(k):
+                a = jnp.zeros((4, 8), dtype=jnp.int32)
+                b = jnp.ones((4, 16), dtype=jnp.int32)
+                ok = jnp.concatenate([a, b], 1)     # on the axis
+                bad = jnp.concatenate([a, b], 0)    # 8 vs 16 off-axis
+                dyn = jnp.concatenate([a, b], k)    # unknowable axis
+                return ok, bad, dyn
+        """,
+    }, families=["shapecheck"])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("shape-mismatch", "m.py:step:concatenate:ax1:8v16"),
+    ]
+
+
+def test_shapecheck_fresh_constructor_exempts_only_its_subtree(
+        tmp_path):
+    """A FRESH_CONSTRUCTORS hit inside ONE branch of a donated
+    expression must not absolve the other branch: in
+    ``pingpong(fodder if ok else make_table(n, c), b)`` the name
+    ``fodder`` is still donated on the taken path, and reading it
+    afterwards is exactly the bug class this rule exists for."""
+    findings = _lint(tmp_path, {
+        "ops/kern.py": """
+            import jax
+
+            def impl(dead, batch):
+                return batch
+
+            pingpong = jax.jit(impl, donate_argnums=(0,))
+
+            def make_table(docs, capacity):
+                return docs
+
+            def bad(fodder, batch, ok):
+                out = pingpong(
+                    fodder if ok else make_table(2, 64), batch)
+                return out, fodder.count    # read after donation
+        """,
+    }, families=["shapecheck"])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("donated-buffer-reuse", "kern.py:bad:fodder"),
+    ]
+
+
+def test_shapecheck_unladdered_keyword_shape_arg(tmp_path):
+    """A shape-determining argument passed by KEYWORD is checked like
+    a positional one — a recompile-storm call site must not pass the
+    gate just by switching to keyword form. Laddered keywords stay
+    clean."""
+    findings = _lint(tmp_path, {
+        "ops/serve.py": """
+            import jax
+            import numpy as np
+
+            from fluidframework_tpu.ops.bucket_ladder import \\
+                BucketLadder
+
+            def impl(batch):
+                return batch
+
+            step = jax.jit(impl)
+
+            WINDOW = 37
+
+            def bad(ops):
+                raw = np.zeros(WINDOW)
+                return step(batch=raw)
+
+            def good(ops):
+                ladder = BucketLadder(16, 64)
+                padded = np.zeros(ladder.bucket(len(ops)))
+                return step(batch=padded)
+        """,
+    }, families=["shapecheck"])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("unladdered-jit-shape", "serve.py:bad:step[batch]"),
+    ]
+
+
+def test_shapecheck_static_argnames_exempt_keyword_args(tmp_path):
+    """``jax.jit(impl, static_argnames=('K',))`` makes K a compile-
+    time constant exactly like a static_argnums slot — passing it by
+    keyword must not be flagged as an unladdered traced shape."""
+    findings = _lint(tmp_path, {
+        "ops/serve.py": """
+            import jax
+            import numpy as np
+
+            from fluidframework_tpu.ops.bucket_ladder import \\
+                BucketLadder
+
+            def impl(batch, K):
+                return batch
+
+            step = jax.jit(impl, static_argnames=("K",))
+
+            def good(ops, k):
+                ladder = BucketLadder(16, 64)
+                padded = np.zeros(ladder.bucket(len(ops)))
+                return step(padded, K=k)    # static keyword: exempt
+        """,
+    }, families=["shapecheck"])
+    assert findings == []
+
+
+def test_shapecheck_rotate_in_loop_is_not_flagged(tmp_path):
+    """The sanctioned rotate idiom inside a loop: the call statement
+    rebinds the donated name, so the wrap-around path reads a LIVE
+    array — seeding the wrap scan with the original donated set would
+    flag it. A genuine pre-call read on the wrap path still fires."""
+    findings = _lint(tmp_path, {
+        "ops/kern.py": """
+            import jax
+
+            def impl(dead, batch):
+                return batch
+
+            pingpong = jax.jit(impl, donate_argnums=(0,))
+
+            def good_rotate(fodder, batches):
+                for b in batches:
+                    n = fodder.count        # live: rebound below
+                    fodder = pingpong(fodder, b)
+                return n
+
+            def bad_wrap(fodder, batches):
+                for b in batches:
+                    n = fodder.count        # wrap: donated last iter
+                    out = pingpong(fodder, b)
+                return n
+        """,
+    }, families=["shapecheck"])
+    assert [f.key for f in findings
+            if f.rule == "donated-buffer-reuse"] == [
+        "kern.py:bad_wrap:fodder",
+    ]
+
+
+def test_shapecheck_local_env_follows_statement_order(tmp_path):
+    """The name environment is built in textual statement order, not
+    ast.walk's breadth-first order: a branch-local laddered rebinding
+    EARLIER in the function must not mask a later top-level raw
+    assignment feeding the jit (BFS visits all top-level assignments
+    before any nested one)."""
+    findings = _lint(tmp_path, {
+        "ops/serve.py": """
+            import jax
+            import numpy as np
+
+            from fluidframework_tpu.ops.bucket_ladder import \\
+                BucketLadder
+
+            def impl(batch):
+                return batch
+
+            step = jax.jit(impl)
+
+            WINDOW = 37
+
+            def bad(ops, fast):
+                if fast:
+                    batch = np.zeros(
+                        BucketLadder(16, 64).bucket(len(ops)))
+                batch = np.zeros(WINDOW)    # raw rebinding WINS
+                return step(batch)
+        """,
+    }, families=["shapecheck"])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("unladdered-jit-shape", "serve.py:bad:step[0]"),
+    ]
+
+
+def test_shapecheck_prewarm_coverage(tmp_path):
+    """prewarm-coverage: a jit root reachable from the sidecar
+    dispatch loop but not from prewarm pays its XLA compile
+    mid-serve. The registries match by relpath suffix, so a fixture
+    service/tpu_sidecar.py exercises the rule."""
+    kern = """
+        import jax
+
+        def _hot(x):
+            return x
+
+        def _cold(x):
+            return x
+
+        hot_step = jax.jit(_hot)
+        cold_step = jax.jit(_cold)
+    """
+    sidecar_cold = """
+        from ops.kern import cold_step, hot_step
+
+        class TpuMergeSidecar:
+            def _dispatch(self, x):
+                return self._apply_program(x)
+
+            def _apply_program(self, x):
+                return cold_step(hot_step(x))
+
+            def prewarm(self):
+                hot_step(0)
+    """
+    findings = _lint(tmp_path, {
+        "ops/kern.py": kern,
+        "service/tpu_sidecar.py": sidecar_cold,
+    }, families=["shapecheck"])
+    assert [(f.rule, f.key) for f in findings] == [
+        ("prewarm-coverage", "kern.py:cold_step"),
+    ]
+    assert "NOT from BucketLadder prewarm" in findings[0].message
+    # walking the missing root in prewarm clears it
+    warmed = sidecar_cold.replace(
+        "hot_step(0)", "cold_step(hot_step(0))")
+    assert _lint(tmp_path / "warm", {
+        "ops/kern.py": kern,
+        "service/tpu_sidecar.py": warmed,
+    }, families=["shapecheck"]) == []
+    # a tree with no registered dispatch-root module skips the rule
+    # (partial scans of leaf modules stay clean)
+    assert _lint(tmp_path / "leaf", {"ops/kern.py": kern},
+                 families=["shapecheck"]) == []
+
+
+def test_cli_changed_mode_scans_only_touched_files(
+        tmp_path, monkeypatch):
+    """`--changed [REF]`: only python files touched vs the ref are
+    scanned (fast local iteration), allowlist staleness is skipped
+    like any partial scan, and mixing --changed with explicit paths
+    is a usage error."""
+    import io
+    import json
+    import subprocess
+    from contextlib import redirect_stdout
+
+    from fluidframework_tpu.analysis import __main__ as cli
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             *args],
+            cwd=tmp_path, check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    svc = tmp_path / "service"
+    svc.mkdir()
+    committed = svc / "committed.py"
+    # a finding IF scanned — proves untouched files stay out
+    committed.write_text("import asyncio\nq = asyncio.Queue()\n")
+    git("add", ".")
+    git("commit", "-q", "-m", "seed")
+    monkeypatch.setattr(cli, "REPO_ROOT", str(tmp_path))
+
+    # clean working tree: nothing to scan, exit 0 (the committed
+    # finding is invisible to --changed)
+    assert cli.main(["--changed", "--rules", "qoscheck"]) == 0
+
+    # an untracked file with a finding is scanned; the committed one
+    # still is not; a stale allowlist entry elsewhere does not fail
+    # the partial scan
+    (svc / "fresh.py").write_text(
+        "from collections import deque\nd = deque()\n")
+    allow = tmp_path / "allow.txt"
+    allow.write_text("lock-unlocked-write Elsewhere.attr\n")
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["--changed", "--rules", "qoscheck", "--json",
+                       "--allowlist", str(allow)])
+    assert rc == 1
+    report = json.loads(buf.getvalue())
+    assert [f["path"] for f in report["findings"]] == [
+        "service/fresh.py"]
+    assert report["stale_allowlist"] == []
+
+    # a file MODIFIED vs the ref joins the scan set
+    committed.write_text(
+        "import asyncio\nq = asyncio.Queue()\nr = asyncio.Queue()\n")
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["--changed", "HEAD", "--rules", "qoscheck",
+                       "--json", "--allowlist", str(allow)])
+    assert rc == 1
+    report = json.loads(buf.getvalue())
+    assert sorted({f["path"] for f in report["findings"]}) == [
+        "service/committed.py", "service/fresh.py"]
+
+    # mutually exclusive with explicit paths (positional first: a
+    # path right after the flag would parse as the REF operand)
+    assert cli.main([str(committed), "--changed"]) == 2
+
+
+def test_cli_changed_with_no_files_still_emits_report(
+        tmp_path, monkeypatch):
+    """`--changed --sarif` on a docs-only diff must emit a VALID
+    empty SARIF document (and `--json` a valid empty report), not
+    zero bytes — a downstream annotator parsing stdout would choke
+    on an empty file."""
+    import io
+    import json
+    import subprocess
+    from contextlib import redirect_stdout
+
+    from fluidframework_tpu.analysis import __main__ as cli
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+             *args],
+            cwd=tmp_path, check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    (tmp_path / "README.md").write_text("docs only\n")
+    git("add", ".")
+    git("commit", "-q", "-m", "seed")
+    monkeypatch.setattr(cli, "REPO_ROOT", str(tmp_path))
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["--changed", "--sarif"]) == 0
+    sarif = json.loads(buf.getvalue())
+    assert sarif["runs"][0]["results"] == []
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["--changed", "--json"]) == 0
+    report = json.loads(buf.getvalue())
+    assert report["findings"] == [] and \
+        report["stale_allowlist"] == []
+
+
 # -------------------------------------------------- key stability (ratchet)
 
 
@@ -1088,11 +1786,34 @@ def test_finding_keys_are_line_free_across_all_families(tmp_path):
             def step(x):
                 return x * time.time()
         """,
+        # shapecheck: donated-buffer-reuse + unladdered-jit-shape +
+        # kernel-dtype-widen all fire, in a ladder-scope path
+        "fluidframework_tpu/ops/hotk.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def impl(dead, batch):
+                return batch.astype(jnp.int64)
+
+            pingpong = jax.jit(impl, donate_argnums=(0,))
+
+            RAW = 37
+
+            def dispatch(fodder, batch):
+                out = pingpong(fodder, batch)
+                return out, fodder.count
+
+            def unladdered(batch):
+                bad = jnp.zeros(RAW)
+                return pingpong(bad, batch)
+        """,
     }
-    baseline = _lint(tmp_path, dict(files),
-                     families=["layercheck", "jaxhazards", "lockcheck",
-                               "qoscheck", "concheck"])
+    key_families = ["layercheck", "jaxhazards", "lockcheck",
+                    "qoscheck", "concheck", "shapecheck"]
+    baseline = _lint(tmp_path, dict(files), families=key_families)
     assert len(baseline) >= 5
+    assert {"donated-buffer-reuse", "unladdered-jit-shape",
+            "kernel-dtype-widen"} <= _rules(baseline)
     shifted_files = {
         # indentation matches the fixture bodies so dedent still
         # normalizes them; only the line NUMBERS move
@@ -1101,8 +1822,7 @@ def test_finding_keys_are_line_free_across_all_families(tmp_path):
         for path, src in files.items()
     }
     shifted = _lint(tmp_path / "shifted", shifted_files,
-                    families=["layercheck", "jaxhazards", "lockcheck",
-                              "qoscheck", "concheck"])
+                    families=key_families)
     assert sorted((f.rule, f.key) for f in baseline) == \
         sorted((f.rule, f.key) for f in shifted)
     # lines DID move — the keys being equal is not vacuous

@@ -8,6 +8,7 @@ never accumulate; see docs/ANALYSIS.md for the policy).
 import json
 import subprocess
 import sys
+import time
 
 from fluidframework_tpu.analysis import core
 
@@ -15,16 +16,26 @@ from fluidframework_tpu.analysis import core
 # number may be LOWERED as entries burn down; never raised.
 MAX_ALLOWLIST_ENTRIES = 10
 
+# wall-clock budget for ONE combined all-family run (the shared
+# per-run callgraph + the memoized _gate() below are what keep this
+# honest). The seven-family run measures ~10s on the dev box; the
+# budget leaves CI headroom while still tripping on a superlinear
+# regression (e.g. a fixpoint that stops converging, or a family
+# rebuilding the callgraph per file).
+GATE_BUDGET_S = 60.0
 
 _GATE_CACHE = None
+_GATE_RUNTIME_S = None
 
 
 def _gate():
     # one full-tree run per pytest session: several tests read the
     # same result, and the interprocedural families are not free
-    global _GATE_CACHE
+    global _GATE_CACHE, _GATE_RUNTIME_S
     if _GATE_CACHE is None:
+        t0 = time.perf_counter()
         findings = core.run_analysis()
+        _GATE_RUNTIME_S = time.perf_counter() - t0
         allowlist = core.load_allowlist()
         kept, stale = core.apply_allowlist(findings, allowlist)
         _GATE_CACHE = (kept, stale, allowlist, findings)
@@ -249,6 +260,10 @@ def test_concheck_family_is_in_the_gate():
     assert "concheck" in core.FAMILIES
 
 
+def test_shapecheck_family_is_in_the_gate():
+    assert "shapecheck" in core.FAMILIES
+
+
 def test_family_rules_map_stays_complete():
     """RULE_FAMILY is how one combined run's findings group per
     family (bench records); a family missing from the map would
@@ -259,7 +274,9 @@ def test_family_rules_map_stays_complete():
                  "slo-unbound-objective",
                  "service-unbounded-queue", "lock-order-cycle",
                  "async-blocking-call", "await-holding-lock",
-                 "dispatch-loop-sync"):
+                 "dispatch-loop-sync", "donated-buffer-reuse",
+                 "unladdered-jit-shape", "kernel-dtype-widen",
+                 "shape-mismatch", "prewarm-coverage"):
         assert rule in core.RULE_FAMILY, rule
 
 
@@ -275,6 +292,37 @@ def test_concheck_live_tree_is_clean_within_the_ratchet():
         "\n".join(f.format() for f in concheck_kept)
     grandfathered = [e for e in allowlist if e[0] in concheck_rules]
     assert len(grandfathered) <= MAX_ALLOWLIST_ENTRIES
+
+
+def test_shapecheck_live_tree_is_clean_within_the_ratchet():
+    """The acceptance bar for the shapecheck family: zero live
+    findings over the real kernel layer with an EMPTY allowlist —
+    everything the new family found (the unwarmed pool-tier dispatch
+    programs) was FIXED in the PR that introduced it, the PR1/PR5
+    precedent. The registries (LADDERED_CALLS, PREWARM_INDIRECT) are
+    the reviewed escape hatch, not the allowlist."""
+    kept, _stale, allowlist = _gate()
+    shape_rules = set(core.FAMILY_RULES["shapecheck"])
+    shape_kept = [f for f in kept if f.rule in shape_rules]
+    assert shape_kept == [], \
+        "\n".join(f.format() for f in shape_kept)
+    grandfathered = [e for e in allowlist if e[0] in shape_rules]
+    assert grandfathered == [], (
+        "shapecheck findings must be fixed, never grandfathered: "
+        f"{grandfathered}"
+    )
+
+
+def test_combined_gate_run_stays_under_budget():
+    """The CI/tooling satellite: seven families, one shared
+    callgraph, one budget. A blowup here means a family stopped
+    reusing the per-run graph or a fixpoint regressed superlinear."""
+    _gate()  # ensures the timed run happened (memoized per session)
+    assert _GATE_RUNTIME_S is not None
+    assert _GATE_RUNTIME_S < GATE_BUDGET_S, (
+        f"combined {len(core.FAMILIES)}-family run took "
+        f"{_GATE_RUNTIME_S:.1f}s, budget is {GATE_BUDGET_S:.0f}s"
+    )
 
 
 def test_cli_sarif_mode_emits_valid_report(tmp_path, monkeypatch):

@@ -1,0 +1,460 @@
+"""jitsan (testing/jitsan.py) — the runtime half of shapecheck — and
+THE two differentials that pin the static analyzer to reality:
+
+(a) observed XLA compile counts per jit root must stay <= the
+    per-root bounds ``shapecheck.ladder_bounds`` derives from the
+    BucketLadder (one extra = an unladdered shape reached a kernel);
+(b) ``shapecheck.infer_kernel_output``'s abstract output signatures
+    must EQUAL ``jax.eval_shape`` for every real kernel root across
+    every ladder rung — an abstract-interpreter gap fails here by
+    name, never silently.
+
+Plus the donation read-traps (the runtime form of
+``donated-buffer-reuse``) and the prewarm-coverage runtime pin:
+after ``prewarm()``, in-ladder serving traffic — including grow
+recovery and pool admission — compiles NOTHING new.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from fluidframework_tpu.analysis.shapecheck import (
+    _pow2_span,
+    infer_kernel_output,
+    ladder_bounds,
+)
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.obs import metrics as obs_metrics
+from fluidframework_tpu.ops import make_table
+from fluidframework_tpu.ops.bucket_ladder import BucketLadder
+from fluidframework_tpu.ops.segment_table import KIND_NOOP, OpBatch
+from fluidframework_tpu.service import LocalServer, TpuMergeSidecar
+from fluidframework_tpu.service.tpu_sidecar import _pack_rows
+from fluidframework_tpu.testing import jitsan
+
+NOOP = dict(
+    kind=KIND_NOOP, pos1=0, pos2=0, seq=0, refseq=0, client=0,
+    op_id=0, length=0, is_marker=0, prop_key=0, prop_val=0, min_seq=0,
+)
+
+
+@pytest.fixture()
+def sanitizer():
+    jitsan.install()
+    jitsan.reset()
+    yield jitsan
+    # deliberate trips belong to the test that made them, not to the
+    # session-wide conftest guard
+    jitsan.reset()
+    jitsan.uninstall()
+
+
+def _batch(docs: int, bucket: int) -> OpBatch:
+    return OpBatch(**_pack_rows(docs, {0: [NOOP]}, bucket_floor=bucket))
+
+
+def _drive(server, sidecar, doc: str, n: int = 24,
+           chunk: str = "abcdefgh"):
+    """Frequent-flush writer traffic: windows stay under the ladder's
+    max_bucket (one flush per apply), segments churn via removes."""
+    factory = LocalDocumentServiceFactory(server)
+    sidecar.subscribe(server, doc, "d", "s")
+    c = Container.load(factory.create_document_service(doc),
+                       client_id=f"{doc}-writer")
+    s = c.runtime.create_datastore("d").create_channel(
+        "sharedstring", "s")
+    for i in range(n):
+        s.insert_text(0, chunk)
+        c.flush()
+        if i % 3 == 2 and s.get_length() > 6:
+            s.remove_text(2, 5)
+            c.flush()
+        sidecar.apply()
+    sidecar.sync()
+    return c, s
+
+
+# ======================================================================
+# differential (a): compile counts <= the static ladder bounds
+
+
+def test_compile_counts_within_ladder_bounds_scan_route(sanitizer):
+    """A prewarmed sidecar driven through real traffic — including an
+    overflow regrow up the capacity ladder — compiles at most the
+    shapes shapecheck derives from the BucketLadder, per root."""
+    ladder = BucketLadder(window_floor=16, max_bucket=32)
+    sidecar = TpuMergeSidecar(
+        max_docs=2, capacity=16, max_capacity=64, executor="scan",
+        donate=False, ladder=ladder,
+    )
+    sidecar.prewarm()
+    server = LocalServer()
+    _drive(server, sidecar, "doc")
+    assert sidecar.grow_count >= 1, "traffic must exercise a regrow"
+    counts = sanitizer.compile_counts()
+    bounds = ladder_bounds(16, 32, 16, 64, executor="scan",
+                           donate=False)
+    for root, bound in bounds.items():
+        assert counts[root] <= bound, (
+            f"{root}: {counts[root]} compiles > static ladder bound "
+            f"{bound} — an unladdered shape reached the kernel"
+        )
+    assert counts["apply_window"] > 0  # the bound check is not vacuous
+
+
+def test_compile_counts_within_ladder_bounds_chunked_route(sanitizer):
+    ladder = BucketLadder(window_floor=16, max_bucket=32)
+    sidecar = TpuMergeSidecar(
+        max_docs=2, capacity=16, max_capacity=64, executor="chunked",
+        donate=False, ladder=ladder,
+    )
+    sidecar.prewarm()
+    server = LocalServer()
+    _drive(server, sidecar, "doc")
+    counts = sanitizer.compile_counts()
+    bounds = ladder_bounds(16, 32, 16, 64, executor="chunked",
+                           donate=False)
+    for root, bound in bounds.items():
+        assert counts[root] <= bound, (root, counts[root], bound)
+    assert counts["chunked"] > 0
+    assert counts["apply_window"] == 0  # the scan jit stayed cold
+
+
+def test_ladder_arithmetic_matches_the_real_enumeration():
+    """shapecheck keeps the ladder arithmetic import-free
+    (_pow2_span); this pins it to the real BucketLadder enumeration
+    so the two can never drift."""
+    for floor, top in ((16, 16), (16, 64), (16, 128), (8, 64)):
+        assert _pow2_span(floor, top) == len(
+            BucketLadder(floor, top).window_buckets())
+    for base, top in ((16, 16), (16, 512), (32, 64)):
+        assert _pow2_span(base, top) == len(
+            BucketLadder.capacity_rungs(base, top))
+    # a non-positive floor never doubles past the top: raise instead
+    # of spinning forever (a misread config used to hang the caller)
+    with pytest.raises(ValueError, match="positive floor"):
+        _pow2_span(0, 64)
+    with pytest.raises(ValueError, match="positive floor"):
+        ladder_bounds(16, 64, 0, 64)
+
+
+# ======================================================================
+# differential (b): abstract output signatures == jax.eval_shape
+
+
+def _sig_of(tree) -> dict:
+    if hasattr(tree, "_fields"):
+        items = zip(tree._fields, tree)
+    else:
+        items = tree.items()
+    return {f: (tuple(a.shape), str(a.dtype)) for f, a in items}
+
+
+RUNGS = (32, 64, 128)
+BUCKETS = (16, 32)
+
+
+@pytest.mark.parametrize("rung", RUNGS)
+@pytest.mark.parametrize("bucket", BUCKETS)
+def test_static_signatures_match_eval_shape_scan(rung, bucket):
+    from fluidframework_tpu.ops.merge_kernel import (
+        apply_window_impl,
+        compact,
+    )
+
+    table = make_table(4, rung)
+    spec = _sig_of(table)
+    batch = _batch(4, bucket)
+    out = jax.eval_shape(apply_window_impl, table, batch)
+    assert infer_kernel_output("apply_window", spec) == _sig_of(out)
+    out = jax.eval_shape(compact, table)
+    assert infer_kernel_output("compact", spec) == _sig_of(out)
+
+
+@pytest.mark.parametrize("rung", RUNGS[:-1])
+def test_static_signatures_match_eval_shape_pad_capacity(rung):
+    from fluidframework_tpu.ops.merge_kernel import pad_capacity
+
+    table = make_table(4, rung)
+    spec = _sig_of(table)
+    out = jax.eval_shape(lambda t: pad_capacity(t, rung * 2), table)
+    assert infer_kernel_output(
+        "pad_capacity", spec, new_capacity=rung * 2) == _sig_of(out)
+
+
+@pytest.mark.parametrize("rung", RUNGS)
+@pytest.mark.parametrize("bucket", BUCKETS)
+def test_static_signatures_match_eval_shape_chunked(rung, bucket):
+    from fluidframework_tpu.ops.merge_chunk import (
+        CHUNK_FIELDS,
+        _chunk_state,
+        _window_loop,
+        build_chunked,
+    )
+    import jax.numpy as jnp
+
+    st = _chunk_state(make_table(4, rung))
+    spec = {f: (tuple(a.shape), str(a.dtype)) for f, a in st.items()}
+    chunked = build_chunked(_batch(4, bucket), K=8)
+    ops_w = {f: jnp.asarray(chunked[f])
+             for f in OpBatch._fields + CHUNK_FIELDS}
+    out = jax.eval_shape(lambda s, o: _window_loop(s, o, 8), st, ops_w)
+    assert infer_kernel_output("chunked", spec) == _sig_of(out)
+
+
+@pytest.mark.parametrize("rung", RUNGS)
+def test_static_signatures_match_eval_shape_seq_shard(rung):
+    from fluidframework_tpu.parallel.seq_shard import (
+        apply_window_seq_sharded,
+        make_seq_mesh,
+    )
+
+    mesh = make_seq_mesh(jax.devices()[:2], doc_shards=1)
+    table = make_table(4, rung)
+    spec = _sig_of(table)
+    out = jax.eval_shape(
+        lambda t, b: apply_window_seq_sharded(t, b, mesh),
+        table, _batch(4, 16),
+    )
+    assert infer_kernel_output("seq_shard", spec) == _sig_of(out)
+
+
+@pytest.mark.parametrize("rung", (128, 256))
+def test_static_signatures_match_eval_shape_pallas(rung):
+    from fluidframework_tpu.ops import pallas_merge
+    from fluidframework_tpu.ops.merge_step import (
+        OP_COLS,
+        table_to_state,
+    )
+    import jax.numpy as jnp
+
+    state = table_to_state(make_table(8, rung))
+    spec = {f: (tuple(a.shape), str(a.dtype))
+            for f, a in state.items()}
+    arrays = _pack_rows(8, {0: [NOOP]}, bucket_floor=16)
+    ops = {f: jnp.asarray(arrays[f]).astype(jnp.int32)
+           for f in OP_COLS}
+    out = jax.eval_shape(pallas_merge._pallas_call, state, ops)
+    assert infer_kernel_output("pallas", spec) == _sig_of(out)
+
+
+def test_infer_kernel_output_rejects_unknown_root():
+    with pytest.raises(ValueError, match="unknown kernel root"):
+        infer_kernel_output("warp_drive", {})
+    with pytest.raises(ValueError, match="new_capacity"):
+        infer_kernel_output("pad_capacity", {})
+
+
+# ======================================================================
+# donation read-traps
+
+
+def test_donated_table_reads_trap_on_any_backend(sanitizer):
+    """apply_window_pingpong consumes its ``dead`` argument; jitsan
+    makes a later read raise even on CPU, where XLA would silently
+    ignore the donation and the bug would only detonate on-chip."""
+    from fluidframework_tpu.ops.merge_kernel import (
+        apply_window_pingpong,
+    )
+
+    table = make_table(2, 32)
+    dead = make_table(2, 32)
+    out = apply_window_pingpong(dead, table, _batch(2, 16))
+    assert [e.root for e in sanitizer.donation_events()] == [
+        "apply_window_pingpong"]
+    with pytest.raises(RuntimeError, match="deleted"):
+        # the deliberate post-donation read the trap exists to catch
+        np.asarray(dead.length)  # fluidlint: disable=donated-buffer-reuse
+    # the live input and the output stay readable
+    np.asarray(table.length)
+    np.asarray(out.length)
+    assert sanitizer.trips() == []
+
+
+def test_donated_chunked_state_reads_trap(sanitizer):
+    from fluidframework_tpu.ops.merge_chunk import (
+        apply_window_chunked_pingpong,
+        build_chunked,
+    )
+
+    table = make_table(2, 32)
+    dead = make_table(2, 32)
+    out = apply_window_chunked_pingpong(
+        dead, table, build_chunked(_batch(2, 16), K=8), K=8)
+    assert [e.root for e in sanitizer.donation_events()] == [
+        "chunked_pingpong"]
+    with pytest.raises(RuntimeError, match="deleted"):
+        # the deliberate post-donation read the trap exists to catch
+        np.asarray(dead.seq)  # fluidlint: disable=donated-buffer-reuse
+    np.asarray(out.length)
+    # dead=None is the explicit plain-dispatch opt-out: no trap
+    jitsan.reset()
+    apply_window_chunked_pingpong(
+        None, table, build_chunked(_batch(2, 16), K=8), K=8)
+    assert sanitizer.donation_events() == []
+
+
+def test_donating_the_live_input_records_a_trip(sanitizer):
+    """The aliasing form of donated-buffer-reuse: one table passed
+    both donated and live. jitsan records a trip (and refuses to
+    delete the shared buffers — the live input must stay readable so
+    the test can report instead of crash)."""
+    from fluidframework_tpu.ops.merge_kernel import (
+        apply_window_pingpong,
+    )
+
+    table = make_table(2, 32)
+    # the deliberate aliasing dispatch the trip exists to catch
+    apply_window_pingpong(table, table, _batch(2, 16))  # fluidlint: disable=donated-buffer-reuse
+    trips = sanitizer.trips()
+    assert trips and all(
+        t.root == "apply_window_pingpong" for t in trips)
+    assert "aliases a live input" in trips[0].describe()
+    np.asarray(table.length)  # not deleted
+    jitsan.reset()  # the trip was deliberate; clear it for the guard
+
+
+def test_keyword_live_args_alias_check_and_survive(sanitizer):
+    """Live inputs passed BY KEYWORD are part of the aliasing check:
+    donating a table that also rides in as ``table=`` records a trip
+    and the shared buffers are NOT deleted (deleting them would
+    corrupt the live input the kernel still reads)."""
+    from fluidframework_tpu.ops.merge_kernel import (
+        apply_window_pingpong,
+    )
+
+    table = make_table(2, 32)
+    # the deliberate keyword-aliasing dispatch the trip exists to catch
+    apply_window_pingpong(table, table=table, batch=_batch(2, 16))  # fluidlint: disable=donated-buffer-reuse
+    trips = sanitizer.trips()
+    assert trips and trips[0].root == "apply_window_pingpong"
+    np.asarray(table.length)  # still readable: not deleted
+    jitsan.reset()  # the trip was deliberate; clear it for the guard
+
+
+def test_sidecar_donate_path_retires_fodder_loudly(sanitizer):
+    """The sidecar's double-buffer discipline under the sanitizer:
+    with donation forced on (CPU falls back to the plain dispatch but
+    the CONTRACT is identical), every retired fodder table is
+    consumed, no trip fires, and serving stays correct — the
+    ping-pong invariant from PR2, machine-checked end to end."""
+    sidecar = TpuMergeSidecar(
+        max_docs=2, capacity=64, max_capacity=64, donate=True,
+        ladder=BucketLadder(16, 16),
+    )
+    server = LocalServer()
+    _, s = _drive(server, sidecar, "doc", n=8)
+    assert sidecar.text("doc", "d", "s") == s.get_text()
+    assert sanitizer.trips() == []
+    assert any(
+        e.root == "apply_window_pingpong"
+        for e in sanitizer.donation_events()
+    )
+
+
+# ======================================================================
+# prewarm coverage, runtime pin + the compile metric
+
+
+def test_prewarm_covers_all_serving_compiles(sanitizer):
+    """After prewarm, in-ladder traffic (incl. grow recovery) pays
+    ZERO mid-serve compiles — the runtime form of shapecheck's
+    prewarm-coverage rule."""
+    ladder = BucketLadder(window_floor=16, max_bucket=32)
+    sidecar = TpuMergeSidecar(
+        max_docs=2, capacity=16, max_capacity=64, executor="scan",
+        donate=False, ladder=ladder,
+    )
+    sidecar.prewarm()
+    jitsan.reset()
+    server = LocalServer()
+    _drive(server, sidecar, "doc")
+    assert sidecar.grow_count >= 1
+    counts = sanitizer.compile_counts()
+    assert all(n == 0 for n in counts.values()), (
+        f"mid-serve compiles after prewarm: "
+        f"{ {r: n for r, n in counts.items() if n} }"
+    )
+
+
+def test_prewarm_covers_pool_admission_compiles(sanitizer):
+    """The pool tier (the gap the prewarm-coverage rule found live:
+    SeqShardedPool dispatched through a program prewarm never
+    walked): with a seq mesh attached, prewarm walks the pool's
+    dispatch programs too, so the FIRST pool admission mid-serve
+    compiles nothing."""
+    from fluidframework_tpu.parallel.seq_shard import make_seq_mesh
+
+    mesh = make_seq_mesh(jax.devices()[:1], doc_shards=1)
+    sidecar = TpuMergeSidecar(
+        max_docs=2, capacity=16, max_capacity=16, executor="scan",
+        donate=False, seq_mesh=mesh, pool_capacity=64,
+        ladder=BucketLadder(16, 16),
+    )
+    sidecar.prewarm()
+    jitsan.reset()
+    server = LocalServer()
+    _, s = _drive(server, sidecar, "doc", n=24)
+    assert sidecar.pooled_docs() == 1, "traffic must exercise the pool"
+    assert sidecar.text("doc", "d", "s") == s.get_text()
+    counts = sanitizer.compile_counts()
+    assert all(n == 0 for n in counts.values()), (
+        f"mid-serve compiles after prewarm: "
+        f"{ {r: n for r, n in counts.items() if n} }"
+    )
+
+
+def test_publish_compiles_feeds_the_registry_counter(sanitizer):
+    from fluidframework_tpu.ops.merge_kernel import compact
+
+    before = obs_metrics.REGISTRY.flat().get(
+        'jax_compiles_total{root="compact"}', 0.0)
+    compact(make_table(3, 32))
+    sizes = jitsan.publish_compiles()
+    assert sizes["compact"] >= 1
+    after = obs_metrics.REGISTRY.flat()[
+        'jax_compiles_total{root="compact"}']
+    assert after > before
+    # monotone watermark: publishing again without new compiles must
+    # not double-count
+    jitsan.publish_compiles()
+    assert obs_metrics.REGISTRY.flat()[
+        'jax_compiles_total{root="compact"}'] == after
+
+
+def test_uninstall_sweeps_late_imported_wrapper_copies():
+    """A module first-imported AFTER install() binds the trap wrapper
+    by value (`from ..ops.merge_kernel import apply_window_pingpong`)
+    and is not in the install-time patch record — uninstall() must
+    sweep it back too, or that module keeps delete()ing donated
+    tables with the sanitizer nominally off."""
+    import sys
+    import types
+
+    from fluidframework_tpu.ops import merge_kernel
+
+    if jitsan.installed():
+        # FFTPU_SANITIZE=1 session: the conftest holds an install
+        # refcount, so a nested install/uninstall pair never restores
+        # anything (by design — the guard stays armed)
+        pytest.skip("session-wide jitsan holds the install refcount")
+
+    original = merge_kernel.apply_window_pingpong
+    jitsan.install()
+    try:
+        wrapper = merge_kernel.apply_window_pingpong
+        assert wrapper is not original
+        late = types.ModuleType("fluidframework_tpu._jitsan_late")
+        late.apply_window_pingpong = wrapper  # the by-value import
+        sys.modules["fluidframework_tpu._jitsan_late"] = late
+    finally:
+        jitsan.uninstall()
+    try:
+        assert merge_kernel.apply_window_pingpong is original
+        assert late.apply_window_pingpong is original, (
+            "late importer kept the trap wrapper after uninstall()"
+        )
+    finally:
+        sys.modules.pop("fluidframework_tpu._jitsan_late", None)
