@@ -56,7 +56,9 @@ ALLOWED = {
     "service": {"models", "native", "obs", "ops", "protocol", "qos",
                 "utils"},
     "native": {"ops", "protocol", "service", "utils"},
-    "parallel": {"ops", "utils"},
+    # obs: the mesh-sharded pool registers its own metric families
+    # (mesh_pool_*) — observation only, obs never imports parallel
+    "parallel": {"obs", "ops", "utils"},
     "testing": {"models", "obs", "ops", "protocol", "qos", "runtime",
                 "service", "utils"},
     "tools": {"drivers", "loader", "models", "obs", "ops", "protocol",

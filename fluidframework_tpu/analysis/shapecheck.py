@@ -58,6 +58,9 @@ LADDER_SCOPE_COMPONENTS = ("ops", "parallel", "service", "tools")
 # every serve-path call site (prewarm/regrow walk capacity_rungs) and
 # fresh tables are setup-time, not per-flush.
 LADDER_SOURCES = (
+    # pack_rows lives in ops/host_bridge.py since the mesh-pool PR;
+    # the sidecar re-exports it as _pack_rows (both names resolve)
+    ("ops/host_bridge.py", "pack_rows"),
     ("service/tpu_sidecar.py", "_pack_rows"),
     ("ops/merge_chunk.py", "compile_chunks"),
     ("ops/merge_chunk.py", "build_chunked"),
@@ -83,6 +86,10 @@ LADDERED_CALLS: dict[tuple[str, str, str], str] = {
     ("tpu_sidecar.py", "TpuMergeSidecar._apply_program",
      "apply_window_chunked_pingpong[K]"):
         "K=CHUNK_K module constant; prewarm walks the ping-pong jits",
+    ("mesh_pool.py", "MeshShardedPool._apply",
+     "apply_window_chunked[K]"):
+        "K=CHUNK_K module constant (single-shard chunked fast path); "
+        "MeshShardedPool.prewarm walks it",
 }
 
 # Calls whose result is freshly allocated (never aliases argument
@@ -118,23 +125,29 @@ PREWARM_ROOTS = {
 #   (relpath suffix, caller qualname) -> ((relpath suffix, qualname), ...)
 PREWARM_INDIRECT = {
     # the pool tier dispatches at the settle boundary through the
-    # attribute-held SeqShardedPool
+    # attribute-held pool — EITHER tier select_pool can return
     ("service/tpu_sidecar.py", "TpuMergeSidecar._settle"): (
         ("service/tpu_sidecar.py", "SeqShardedPool.dispatch_pending"),
+        ("parallel/mesh_pool.py", "MeshShardedPool.dispatch_pending"),
     ),
     ("service/tpu_sidecar.py", "TpuMergeSidecar._recover"): (
         ("service/tpu_sidecar.py", "TpuMergeSidecar._admit_to_pool"),
     ),
     ("service/tpu_sidecar.py", "TpuMergeSidecar._admit_to_pool"): (
         ("service/tpu_sidecar.py", "SeqShardedPool.admit"),
+        ("parallel/mesh_pool.py", "MeshShardedPool.admit"),
     ),
     # prewarm warms the pool tier through the same attribute
     ("service/tpu_sidecar.py", "TpuMergeSidecar._warm_pool"): (
         ("service/tpu_sidecar.py", "SeqShardedPool.prewarm"),
+        ("parallel/mesh_pool.py", "MeshShardedPool.prewarm"),
     ),
-    # _replay_chunked receives the pool's _apply as a callback value
-    ("service/tpu_sidecar.py", "_replay_chunked"): (
+    # replay_chunked receives the pool's _apply as a callback value
+    # (lives in ops/host_bridge.py since the mesh-pool PR; the
+    # sidecar re-exports it as _replay_chunked)
+    ("ops/host_bridge.py", "replay_chunked"): (
         ("service/tpu_sidecar.py", "SeqShardedPool._apply"),
+        ("parallel/mesh_pool.py", "MeshShardedPool._apply"),
     ),
 }
 
@@ -1483,7 +1496,9 @@ def ladder_bounds(window_floor: int, max_bucket: int,
                   capacity: int, max_capacity: int,
                   executor: str = "scan",
                   donate: bool = False,
-                  pallas: bool = False) -> dict[str, int]:
+                  pallas: bool = False,
+                  pool_capacity: Optional[int] = None,
+                  pool_rows: int = 1) -> dict[str, int]:
     """Static per-root compile-count bounds for a sidecar configured
     with this ladder: the number of distinct (window-bucket,
     capacity-rung) shapes each jit root can legally see when every
@@ -1512,6 +1527,35 @@ def ladder_bounds(window_floor: int, max_bucket: int,
     else:
         bounds["apply_window"] = 0
         bounds["apply_window_pingpong"] = 0
+    if pool_capacity is not None:
+        # MeshShardedPool jit roots (per-shard ladder x sharding
+        # signatures): ``pool_rows`` is the largest per-shard row
+        # bucket the run may reach, so the doc-shape ladder is the
+        # pow2 span 1..pool_rows. Window buckets are the sidecar
+        # ladder's span (pool tails come from the same serving
+        # windows) plus the replay chunk bucket when it lies outside
+        # it. Every shape compiles at most TWICE: once with fresh
+        # NamedSharding placement (a rebuild's make_table) and once
+        # with the committed sharding a pool-dispatch output carries
+        # — the two input-sharding signatures prewarm walks.
+        chunk = max(16, min(256, pool_capacity // 4))
+        rb = _pow2_span(1, max(pool_rows, 1))
+        n_windows = _pow2_span(window_floor, max_bucket)
+        if not (window_floor <= chunk <= max_bucket):
+            n_windows += 1
+        bounds["mesh_pool"] = rb * n_windows * 2
+        # one gather program per pool table shape (x2 sharding sigs).
+        # The migration handoff ALWAYS donates on backends that
+        # support it (shard_moves.migrate_rows routes on the backend,
+        # NOT on the sidecar donate flag — the handoff contract is
+        # unconditional), so the donating form's bound must hold
+        # regardless of `donate`: on CPU it stays cold (observed 0 <=
+        # bound), on TPU it is the form every migration compiles
+        bounds["mesh_move"] = rb * 2
+        bounds["mesh_move_pingpong"] = rb * 2
+        # compact follows every pool dispatch: one extra signature
+        # per pool table shape rides the shared compact root
+        bounds["compact"] += rb * 2
     return bounds
 
 

@@ -55,3 +55,17 @@ class BucketLadder:
         while out[-1] < max_capacity:
             out.append(out[-1] * 2)
         return out
+
+    @staticmethod
+    def replay_chunk(capacity: int) -> int:
+        """The pool tiers' full-stream replay chunk for a slab of
+        ``capacity`` slots — ONE definition (both pools' replay and
+        prewarm read it). Leaves headroom for worst-case transient
+        growth inside one chunk: each op can add 2 slots and
+        compaction only runs between chunks, so chunk=256 against a
+        small pool would overflow on history alone even when the
+        live set fits. NOTE: ``shapecheck.ladder_bounds`` restates
+        this arithmetic import-free by design (the linter imports
+        nothing it lints); the jitsan compile-count differential
+        pins the two together."""
+        return max(16, min(256, capacity // 4))

@@ -253,6 +253,65 @@ def coalesce_noops(ops: list[dict]) -> list[dict]:
     return out
 
 
+def pack_rows(n_rows: int, ops_by_row: dict,
+              bucket_floor: int = 16) -> dict:
+    """Pack per-row op lists into padded [n_rows, bucket] arrays with
+    power-of-two window bucketing — THE op-packing recipe (one
+    definition; the sidecar's primary dispatch, the grow/replay
+    ladders, and BOTH pool tiers use it, so the fill/bucket policy
+    cannot drift). Lived in service/tpu_sidecar.py as ``_pack_rows``
+    through PR 7; moved down here so the parallel layer's mesh pool
+    can import it WITHOUT reaching up into service (the sidecar
+    re-exports the old name).
+
+    Vectorized: one fromiter pass builds a [total_ops, n_fields]
+    matrix, then one fancy-index scatter per field lands it — no
+    per-op per-field Python loop (the old quadratic-ish host cost on
+    the serving path)."""
+    from .bucket_ladder import BucketLadder
+
+    window = max((len(v) for v in ops_by_row.values()), default=0)
+    bucket = BucketLadder(window_floor=bucket_floor).window_bucket(window)
+    arrays = {f: np.zeros((n_rows, bucket), np.int32)
+              for f in OP_FIELDS}
+    arrays["kind"][:] = KIND_NOOP
+    items = [(row, ops) for row, ops in ops_by_row.items() if ops]
+    if not items:
+        return arrays
+    lens = np.array([len(ops) for _, ops in items], np.int64)
+    total = int(lens.sum())
+    row_idx = np.repeat(np.array([r for r, _ in items], np.int64), lens)
+    starts = np.cumsum(lens) - lens
+    col_idx = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
+    n_fields = len(OP_FIELDS)
+    flat = np.fromiter(
+        (op[f] for _, ops in items for op in ops for f in OP_FIELDS),
+        np.int32, count=total * n_fields,
+    ).reshape(total, n_fields)
+    dst = row_idx * bucket + col_idx
+    for j, f in enumerate(OP_FIELDS):
+        arrays[f].reshape(-1)[dst] = flat[:, j]
+    return arrays
+
+
+def replay_chunked(apply_fn, table, ops_by_row: dict,
+                   chunk: int = 256):
+    """Re-replay full per-row op histories in fixed-size chunked
+    dispatches (the pool tiers' regrow/admission recipe; chunk
+    sizing: ``BucketLadder.replay_chunk``)."""
+    n_rows = table.docs
+    longest = max((len(v) for v in ops_by_row.values()), default=0)
+    for start in range(0, longest, chunk):
+        arrays = pack_rows(
+            n_rows,
+            {r: ops[start:start + chunk]
+             for r, ops in ops_by_row.items()},
+            bucket_floor=chunk,
+        )
+        table = apply_fn(table, arrays)
+    return table
+
+
 def build_batch(streams: list[DocStream],
                 window: Optional[int] = None) -> OpBatch:
     """Pack per-doc streams into [docs, window] OpBatch arrays, padded

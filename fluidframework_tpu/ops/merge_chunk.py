@@ -78,6 +78,12 @@ from .segment_table import (
 # extra per-op int32 arrays the chunk compiler emits alongside OpBatch
 CHUNK_FIELDS = ("chunk_start", "pred", "ev_cover")
 
+# Serving-side default chunk length (must be <= 31; 8 is the
+# bench-proven sweet spot). Lived in service/tpu_sidecar.py as
+# CHUNK_K through PR 7; owned here so the parallel layer's pool can
+# route chunked without importing service (the sidecar re-exports).
+CHUNK_K = 8
+
 
 # ======================================================================
 # host chunk compiler

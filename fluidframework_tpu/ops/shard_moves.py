@@ -1,0 +1,69 @@
+"""Cross-shard row movement for the mesh-sharded document pool.
+
+A pooled document's row is self-contained: the merge step never reads
+across the doc axis, so a row's slot state depends only on its own op
+stream. Moving a document between shards of a docs-sharded table is
+therefore a pure permutation gather on dim 0 — the op-ordered handoff
+of "On Coordinating Collaborative Objects" (arXiv 1007.5093) reduced
+to tensor form. Performed at the settle boundary, with every member's
+stream watermark already applied and nothing in flight, the move
+commutes with the op order by construction, which is what lets the
+route-parity differential pin a migrated run bit-exact against the
+never-migrated single-shard pool (tests/test_mesh_pool.py).
+
+Two entry points share one gather body:
+
+- ``take_rows``: plain gather — the source table stays readable
+  (prewarm, read-side reshuffles).
+- ``migrate_rows``: the migration handoff — the source table is
+  DONATED (its buffers may back the permuted output, so the O(table)
+  copy costs nothing extra on-chip). The caller must drop every
+  reference to the source; under ``FFTPU_SANITIZE=1`` jitsan
+  delete()s it after the dispatch so a read-after-donate raises at
+  the read site on ANY backend (testing/jitsan.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .segment_table import SegmentTable
+
+
+def _take_rows_impl(table: SegmentTable, idx) -> SegmentTable:
+    """Output row r holds input row ``idx[r]``, every field (all
+    SegmentTable leaves carry the doc axis on dim 0)."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.take(a, idx, axis=0), table
+    )
+
+
+_take_rows_jit = jax.jit(_take_rows_impl)
+
+# the donating form: the source table is consumed (see migrate_rows)
+_migrate_rows_donating = jax.jit(_take_rows_impl, donate_argnums=(0,))
+
+
+def take_rows(table: SegmentTable, idx) -> SegmentTable:
+    """Non-donating row gather: ``table`` stays live and readable."""
+    return _take_rows_jit(table, jnp.asarray(idx, jnp.int32))
+
+
+def migrate_rows(table: SegmentTable, idx) -> SegmentTable:
+    """Donating row gather — the cross-shard migration handoff.
+
+    ``table`` is CONSUMED: XLA may reuse its buffers for the permuted
+    output, so the caller must drop every reference after this call
+    (docs/PERF.md buffer-ownership rules; the static rule is
+    shapecheck's donated-buffer-reuse, the runtime trap is jitsan's).
+
+    On backends without donation support (CPU) this degrades to the
+    plain gather — same result, no buffer reuse — but the ownership
+    CONTRACT is identical everywhere: jitsan delete()s the source on
+    any backend, so a read-after-migrate fails in CPU CI, not on-chip.
+    """
+    idx = jnp.asarray(idx, jnp.int32)
+    if jax.default_backend() == "cpu":
+        # CPU ignores donation with a per-call warning; skip the noise
+        return _take_rows_jit(table, idx)
+    return _migrate_rows_donating(table, idx)

@@ -8,6 +8,10 @@ from .mesh import (
     scalar_sharding,
     shard_pytree,
 )
+from .mesh_pool import (
+    MeshShardedPool,
+    apply_window_mesh_sharded,
+)
 from .seq_shard import (
     SEQ_AXIS,
     apply_window_seq_sharded,
@@ -24,7 +28,9 @@ from .distributed import (
 __all__ = [
     "DOC_AXIS",
     "DistributedConfig",
+    "MeshShardedPool",
     "SEQ_AXIS",
+    "apply_window_mesh_sharded",
     "ensure_initialized",
     "local_doc_slice",
     "make_global_mesh",

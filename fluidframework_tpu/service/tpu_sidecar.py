@@ -66,8 +66,13 @@ from ..ops import (
     make_table,
 )
 from ..ops.bucket_ladder import BucketLadder
-from ..ops.host_bridge import OP_FIELDS
+from ..ops.host_bridge import (
+    OP_FIELDS,
+    pack_rows as _pack_rows,
+    replay_chunked as _replay_chunked,
+)
 from ..ops.merge_chunk import (
+    CHUNK_K,
     apply_window_chunked,
     apply_window_chunked_pingpong,
     compile_chunks,
@@ -76,9 +81,11 @@ from ..ops.merge_kernel import apply_window_pingpong
 from ..ops.segment_table import KIND_NOOP
 from ..protocol.messages import MessageType, SequencedMessage
 
-# chunk length of the service-side chunked dispatches (must be <= 31;
-# 8 matches the bench-proven sweet spot, ops/merge_chunk.py)
-CHUNK_K = 8
+# CHUNK_K, _pack_rows and _replay_chunked live in ops/ since the
+# mesh-pool PR (merge_chunk.CHUNK_K, host_bridge.pack_rows /
+# replay_chunked — both pool tiers share them with this module); the
+# old names are re-exported above because they are part of this
+# module's de-facto surface (tests, bench's legacy-pack monkeypatch).
 
 # Registry families (process aggregates across every sidecar/pool
 # instance; exact per-instance counts stay on the owning object —
@@ -119,6 +126,10 @@ _M_POOL_WATERMARK = obs_metrics.REGISTRY.gauge(
     "pool_watermark_ops", "sum of member stream watermarks")
 _M_POOL_MEMBERS = obs_metrics.REGISTRY.gauge(
     "pool_members", "documents admitted to the pool")
+_M_POOL_ROUTE_FALLBACK = obs_metrics.REGISTRY.counter(
+    "pool_route_fallback_total",
+    "SeqShardedPool chunked-route requests served by the "
+    "scan-collective executor on a real seq mesh")
 
 
 def default_executor() -> str:
@@ -152,58 +163,6 @@ def default_executor() -> str:
     return "chunked" if backend == "tpu" else "scan"
 
 
-def _pack_rows(n_rows: int, ops_by_row: dict,
-               bucket_floor: int = 16) -> dict:
-    """Pack per-row op lists into padded [n_rows, bucket] arrays with
-    power-of-two window bucketing — THE op-packing recipe (one
-    definition; the primary dispatch, the grow/replay ladders, and the
-    pool all use it, so the fill/bucket policy cannot drift).
-
-    Vectorized: one fromiter pass builds a [total_ops, n_fields]
-    matrix, then one fancy-index scatter per field lands it — no
-    per-op per-field Python loop (the old quadratic-ish host cost on
-    the serving path)."""
-    window = max((len(v) for v in ops_by_row.values()), default=0)
-    bucket = BucketLadder(window_floor=bucket_floor).window_bucket(window)
-    arrays = {f: np.zeros((n_rows, bucket), np.int32)
-              for f in OP_FIELDS}
-    arrays["kind"][:] = KIND_NOOP
-    items = [(row, ops) for row, ops in ops_by_row.items() if ops]
-    if not items:
-        return arrays
-    lens = np.array([len(ops) for _, ops in items], np.int64)
-    total = int(lens.sum())
-    row_idx = np.repeat(np.array([r for r, _ in items], np.int64), lens)
-    starts = np.cumsum(lens) - lens
-    col_idx = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
-    n_fields = len(OP_FIELDS)
-    flat = np.fromiter(
-        (op[f] for _, ops in items for op in ops for f in OP_FIELDS),
-        np.int32, count=total * n_fields,
-    ).reshape(total, n_fields)
-    dst = row_idx * bucket + col_idx
-    for j, f in enumerate(OP_FIELDS):
-        arrays[f].reshape(-1)[dst] = flat[:, j]
-    return arrays
-
-
-def _replay_chunked(apply_fn, table, ops_by_row: dict,
-                    chunk: int = 256):
-    """Re-replay full per-row op histories in fixed-size chunked
-    dispatches (the regrow/admission recipe)."""
-    n_rows = table.docs
-    longest = max((len(v) for v in ops_by_row.values()), default=0)
-    for start in range(0, longest, chunk):
-        arrays = _pack_rows(
-            n_rows,
-            {r: ops[start:start + chunk]
-             for r, ops in ops_by_row.items()},
-            bucket_floor=chunk,
-        )
-        table = apply_fn(table, arrays)
-    return table
-
-
 class SeqShardedPool:
     """Long-document tier (SURVEY §5.7 in the PRODUCT path): documents
     that outgrow the primary slab ladder move to a table whose SLOT
@@ -224,6 +183,12 @@ class SeqShardedPool:
                  executor: Optional[str] = None):
         from ..parallel.seq_shard import SEQ_AXIS
 
+        if SEQ_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"seq pool needs a {SEQ_AXIS!r} mesh axis (got "
+                f"{mesh.axis_names}); a docs-sharded mesh routes to "
+                "MeshShardedPool (select_pool)"
+            )
         n_seq = mesh.shape[SEQ_AXIS]
         if per_doc_capacity % n_seq or per_doc_capacity // n_seq < 2:
             raise ValueError(
@@ -242,8 +207,11 @@ class SeqShardedPool:
         # the chunked macro-step's global multi-key sort does not
         # decompose over a slot-sharded axis, so the chunked route
         # applies only on a degenerate (n_seq == 1) mesh; a real seq
-        # mesh keeps the scan-collective executor (docs/PERF.md)
+        # mesh keeps the scan-collective executor (docs/PERF.md) and
+        # SAYS SO once (counter + stderr, _warn_route_once) — the
+        # silent off-route fallback used to be invisible
         self.executor = executor or default_executor()
+        self._route_warned = False
         self.members: list[int] = []      # sidecar slot per pool row
         self.row_of: dict[int, int] = {}  # sidecar slot -> row
         # per-member STREAM WATERMARK: how many of the slot's canonical
@@ -267,6 +235,22 @@ class SeqShardedPool:
             b *= 2
         return b
 
+    def _warn_route_once(self) -> None:
+        if self._route_warned:
+            return
+        self._route_warned = True
+        _M_POOL_ROUTE_FALLBACK.inc()
+        import sys
+
+        print(
+            "fftpu: SeqShardedPool: the chunked macro-step does not "
+            "decompose over a slot-sharded axis; using the "
+            f"scan-collective route on this {self.n_seq}-way seq mesh "
+            "(a docs-sharded MeshShardedPool follows the executor "
+            "route — see select_pool)",
+            file=sys.stderr, flush=True,
+        )
+
     def _apply(self, table, arrays):
         from ..parallel import apply_window_seq_sharded
 
@@ -275,6 +259,8 @@ class SeqShardedPool:
                 table, compile_chunks(arrays, k_max=CHUNK_K), K=CHUNK_K
             )
         else:
+            if self.executor == "chunked":
+                self._warn_route_once()
             out = apply_window_seq_sharded(
                 table, OpBatch(**arrays), self.mesh
             )
@@ -291,16 +277,11 @@ class SeqShardedPool:
             self._table = None
             return
         table = make_table(self._bucket(), self.capacity)
-        # chunk must leave headroom for the WORST-CASE transient
-        # growth inside one chunk (each op can add 2 slots; compaction
-        # only runs between chunks): chunk=256 against a small pool
-        # would overflow on history alone even when the live set fits
-        chunk = max(16, min(256, self.capacity // 4))
         self._table = _replay_chunked(
             self._apply, table,
             {row: streams[slot].ops
              for row, slot in enumerate(self.members)},
-            chunk=chunk,
+            chunk=BucketLadder.replay_chunk(self.capacity),
         )
         self.applied_upto = {
             slot: len(streams[slot].ops) for slot in self.members
@@ -393,7 +374,7 @@ class SeqShardedPool:
             client=0, op_id=0, length=0, is_marker=0,
             prop_key=0, prop_val=0, min_seq=0,
         )
-        chunk = max(16, min(256, self.capacity // 4))
+        chunk = BucketLadder.replay_chunk(self.capacity)
         for floor in sorted({16, chunk}):
             arrays = _pack_rows(1, {0: [noop]}, bucket_floor=floor)
             # each floor needs BOTH input signatures: a fresh
@@ -417,6 +398,78 @@ class SeqShardedPool:
         return fetch(self._table)
 
 
+def select_pool(mesh, per_doc_capacity: Optional[int] = None,
+                executor: Optional[str] = None,
+                route: Optional[str] = None,
+                max_capacity: int = 16384):
+    """THE route-selection point between the two pool tiers — every
+    sidecar pool is constructed here, nowhere else.
+
+    - a mesh with a real ``seq`` axis (size > 1) -> ``SeqShardedPool``
+      (one long document's SLOT axis split across devices);
+    - a mesh with a sharded ``docs`` axis -> ``MeshShardedPool``
+      (many pooled documents spread across shards, live migration);
+    - a single-shard mesh -> whichever tier matches its axis names
+      (a degenerate ``seq`` mesh keeps the existing SeqShardedPool
+      path; a ``docs`` mesh gets a 1-shard MeshShardedPool — both
+      follow the executor route there).
+
+    ``route='seq'|'mesh'`` (constructor arg) or
+    ``FFTPU_SIDECAR_POOL=seq|mesh`` (env, arg wins) overrides; an
+    unknown value fails LOUDLY, and an override that does not fit the
+    mesh fails in the chosen pool's own validation — an emergency
+    route change must never silently not happen.
+
+    Default ``per_doc_capacity``: the seq pool multiplies the primary
+    ladder top by its seq-shard count (per-doc capacity is the point
+    of slot sharding); the mesh pool grants 4x the ladder top (its
+    capacity unlock is MEMBER COUNT — per-doc stays chip-local)."""
+    source = "pool_route"
+    if route is None:
+        route = os.environ.get("FFTPU_SIDECAR_POOL") or None
+        source = "FFTPU_SIDECAR_POOL"
+    if route is not None and route not in ("seq", "mesh"):
+        # BOTH spellings of the escape hatch fail loudly on a typo —
+        # a constructor-arg route change must never silently not
+        # happen any more than an env one
+        raise ValueError(
+            f"{source}={route!r}: expected 'seq' or 'mesh'"
+        )
+    from ..parallel.mesh import DOC_AXIS
+    from ..parallel.seq_shard import SEQ_AXIS
+
+    seq_n = mesh.shape.get(SEQ_AXIS, 1) \
+        if SEQ_AXIS in mesh.axis_names else 1
+    doc_n = mesh.shape.get(DOC_AXIS, 1) \
+        if DOC_AXIS in mesh.axis_names else 1
+    if route is None:
+        if seq_n > 1:
+            route = "seq"
+        elif doc_n > 1:
+            route = "mesh"
+        else:
+            route = "seq" if SEQ_AXIS in mesh.axis_names else "mesh"
+    if route == "mesh":
+        from ..parallel.mesh_pool import MeshShardedPool
+
+        if per_doc_capacity is None:
+            # capped: per-doc capacity is chip-local here, and the
+            # merge step's op_off composite needs
+            # capacity * OPOFF_BOUND < 2^31 (segment_table.py)
+            per_doc_capacity = min(max_capacity * 4, 8192)
+        # resolve the backend-default route HERE (the mesh pool lives
+        # below service and cannot read it itself): a single-shard
+        # docs mesh must follow the chunked fast path on TPU exactly
+        # like the degenerate seq pool does
+        return MeshShardedPool(
+            mesh, per_doc_capacity,
+            executor=executor or default_executor(),
+        )
+    if per_doc_capacity is None:
+        per_doc_capacity = max_capacity * seq_n
+    return SeqShardedPool(mesh, per_doc_capacity, executor=executor)
+
+
 class TpuMergeSidecar:
     """Batched merge state for up to ``max_docs`` sequence channels.
 
@@ -430,6 +483,7 @@ class TpuMergeSidecar:
     def __init__(self, max_docs: int = 1024, capacity: int = 1024,
                  compact_every: int = 8, max_capacity: int = 16384,
                  seq_mesh=None, pool_capacity: Optional[int] = None,
+                 pool_route: Optional[str] = None,
                  executor: Optional[str] = None,
                  pipeline: Optional[bool] = None,
                  donate: Optional[bool] = None,
@@ -513,17 +567,16 @@ class TpuMergeSidecar:
                 except RuntimeError:  # pragma: no cover - init failure
                     self.donate = False
         self.ladder = ladder or BucketLadder()
-        # long-document tier: past the ladder top, docs move to a
-        # sequence-sharded pool on this mesh (SURVEY §5.7) before any
-        # host eviction
-        self._pool: Optional[SeqShardedPool] = None
+        # pool tier: past the ladder top, docs move to a mesh pool —
+        # slot-sharded (SeqShardedPool, SURVEY §5.7) or doc-sharded
+        # (MeshShardedPool, SURVEY §2.9) per the mesh's axes — before
+        # any host eviction. ``select_pool`` is the ONE routing point;
+        # ``pool_route``/FFTPU_SIDECAR_POOL override it.
+        self._pool = None
         if seq_mesh is not None:
-            if pool_capacity is None:
-                from ..parallel.seq_shard import SEQ_AXIS
-
-                pool_capacity = max_capacity * seq_mesh.shape[SEQ_AXIS]
-            self._pool = SeqShardedPool(
-                seq_mesh, pool_capacity, executor=self.executor
+            self._pool = select_pool(
+                seq_mesh, pool_capacity, executor=self.executor,
+                route=pool_route, max_capacity=max_capacity,
             )
         self.pool_admit_count = 0
         self._table = make_table(max_docs, capacity)
@@ -747,9 +800,9 @@ class TpuMergeSidecar:
 
     def _warm_pool(self) -> None:
         """Walk the pool tier's dispatch programs (see
-        ``SeqShardedPool.prewarm``) — reached through the attribute-
-        held pool, so the edge is declared in
-        shapecheck.PREWARM_INDIRECT."""
+        ``SeqShardedPool.prewarm`` / ``MeshShardedPool.prewarm``) —
+        reached through the attribute-held pool, so both edges are
+        declared in shapecheck.PREWARM_INDIRECT."""
         self._pool.prewarm()
 
     def _compile_program(self, arrays: dict) -> dict:

@@ -80,6 +80,12 @@ _JIT_ATTRS = {
         "fluidframework_tpu.ops.merge_kernel", "compact"),
     "pallas": (
         "fluidframework_tpu.ops.pallas_merge", "_call"),
+    # the mesh pool's migration gather (ops/shard_moves.py): plain
+    # form (CPU / prewarm) and the donating handoff form (TPU)
+    "mesh_move": (
+        "fluidframework_tpu.ops.shard_moves", "_take_rows_jit"),
+    "mesh_move_pingpong": (
+        "fluidframework_tpu.ops.shard_moves", "_migrate_rows_donating"),
 }
 
 # factory caches of jit objects (dict -> jit): root -> (module, attr)
@@ -90,6 +96,8 @@ _JIT_CACHES = {
         "fluidframework_tpu.ops.merge_chunk", "_jit_pingpong_cache"),
     "seq_shard": (
         "fluidframework_tpu.parallel.seq_shard", "_compiled_cache"),
+    "mesh_pool": (
+        "fluidframework_tpu.parallel.mesh_pool", "_compiled_cache"),
 }
 
 ROOTS = tuple(sorted((*_JIT_ATTRS, *_JIT_CACHES)))
@@ -101,6 +109,10 @@ _DONATING_WRAPPERS = (
      "apply_window_pingpong", "apply_window_pingpong"),
     ("fluidframework_tpu.ops.merge_chunk",
      "apply_window_chunked_pingpong", "chunked_pingpong"),
+    # the migration handoff consumes its SOURCE table (position 0) —
+    # the pool must never read the pre-move table after the gather
+    ("fluidframework_tpu.ops.shard_moves",
+     "migrate_rows", "mesh_move_pingpong"),
 )
 
 

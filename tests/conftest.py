@@ -70,6 +70,41 @@ def _fluidsan_trip_guard():
 
 
 @pytest.fixture()
+def mesh_cpu_subprocess():
+    """Run a python snippet in a subprocess pinned to a 4-device
+    virtual CPU mesh (JAX_PLATFORMS=cpu +
+    XLA_FLAGS=--xla_force_host_platform_device_count=4) — the
+    mesh-pool suite's multi-shard paths execute on CPU-only CI
+    regardless of how the PARENT session configured its devices
+    (bench config10 emulates shards the same way). The env is
+    subprocess-scoped: nothing leaks into this process, whose jax is
+    already initialized."""
+    import subprocess
+    import sys
+
+    def run(code: str, timeout: float = 300.0) -> str:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        # the child asserts its own invariants; the session sanitizer
+        # belongs to THIS process's conftest guard, not the child
+        env.pop("FFTPU_SANITIZE", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            env=env)
+        assert proc.returncode == 0, (
+            f"mesh subprocess failed rc={proc.returncode}:\n"
+            f"{proc.stderr[-2000:]}"
+        )
+        return proc.stdout
+
+    return run
+
+
+@pytest.fixture()
 def alfred(monkeypatch):
     """AlfredServer on a background event loop — ONE definition for
     every wire-level test file. ``start(tenants=..., 

@@ -51,6 +51,34 @@ def sanitizer():
     jitsan.uninstall()
 
 
+@pytest.fixture()
+def cold_mesh_caches(monkeypatch):
+    """Fresh jit caches for the mesh-pool roots: any earlier test in
+    the process that drove the same (mesh, ladder) shapes leaves the
+    module caches warm, and a warm-cache run observes ZERO new
+    compiles — which would make the non-vacuity asserts below fail
+    (and the bound differential vacuous) depending on suite order."""
+    from fluidframework_tpu.ops import shard_moves
+    from fluidframework_tpu.parallel import mesh_pool as mp
+
+    # jit caches key on FUNCTION IDENTITY: re-jitting the same impl
+    # inherits the warm signatures, so each replacement jit wraps a
+    # fresh function object to start genuinely cold
+    def _fresh_take(table, idx):
+        return shard_moves._take_rows_impl(table, idx)
+
+    def _fresh_migrate(table, idx):
+        return shard_moves._take_rows_impl(table, idx)
+
+    monkeypatch.setattr(mp, "_compiled_cache", {})
+    monkeypatch.setattr(
+        shard_moves, "_take_rows_jit", jax.jit(_fresh_take))
+    monkeypatch.setattr(
+        shard_moves, "_migrate_rows_donating",
+        jax.jit(_fresh_migrate, donate_argnums=(0,)))
+    jitsan.reset()  # baseline the fresh (empty) caches
+
+
 def _batch(docs: int, bucket: int) -> OpBatch:
     return OpBatch(**_pack_rows(docs, {0: [NOOP]}, bucket_floor=bucket))
 
@@ -138,6 +166,117 @@ def test_ladder_arithmetic_matches_the_real_enumeration():
         _pow2_span(0, 64)
     with pytest.raises(ValueError, match="positive floor"):
         ladder_bounds(16, 64, 0, 64)
+
+
+def test_mesh_pool_compile_counts_within_ladder_bounds(
+        sanitizer, cold_mesh_caches):
+    """The mesh-pool route under differential (a): an UN-prewarmed
+    2-shard mesh-pool sidecar driven through admission, incremental
+    dispatch, and a live migration compiles at most the shapes
+    ladder_bounds derives for the mesh_pool/mesh_move roots (per-
+    shard row-bucket ladder x window buckets x sharding signatures),
+    and the pool's compact signatures stay inside the extended
+    compact bound."""
+    from fluidframework_tpu.parallel import make_mesh
+
+    ladder = BucketLadder(window_floor=16, max_bucket=32)
+    sidecar = TpuMergeSidecar(
+        max_docs=6, capacity=16, max_capacity=16, executor="scan",
+        donate=False, ladder=ladder,
+        seq_mesh=make_mesh(jax.devices()[:2]), pool_capacity=256,
+    )
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    docs = {}
+    for i in range(3):
+        doc = f"doc-{i}"
+        sidecar.subscribe(server, doc, "d", "s")
+        c = Container.load(factory.create_document_service(doc),
+                           client_id=f"{doc}-w")
+        s = c.runtime.create_datastore("d").create_channel(
+            "sharedstring", "s")
+        for _ in range(20):
+            s.insert_text(0, "abcdefgh")
+            c.flush()
+        docs[doc] = (c, s)
+    sidecar.apply()
+    sidecar.sync()
+    assert sidecar.pooled_docs() == 3
+    # hot-spot traffic (windows stay under max_bucket per settle)
+    for _ in range(5):
+        for doc, (c, s) in docs.items():
+            n = 10 if doc == "doc-0" else 1
+            for _ in range(n):
+                s.insert_text(0, "XY")
+            c.flush()
+        sidecar.apply()
+        sidecar.sync()
+    assert sidecar._pool.migration_count > 0, (
+        "traffic must exercise a migration")
+    counts = sanitizer.compile_counts()
+    bounds = ladder_bounds(
+        16, 32, 16, 16, executor="scan", donate=False,
+        pool_capacity=256, pool_rows=sidecar._pool.rows_per_shard,
+    )
+    for root in ("mesh_pool", "mesh_move", "mesh_move_pingpong",
+                 "compact"):
+        assert counts[root] <= bounds[root], (
+            f"{root}: {counts[root]} compiles > static ladder bound "
+            f"{bounds[root]} — an unladdered shape reached the "
+            "mesh-pool route"
+        )
+    assert counts["mesh_pool"] > 0    # the bound check is not vacuous
+    assert counts["mesh_move"] > 0    # the migration gather ran
+
+
+def test_mesh_pool_bounds_arithmetic():
+    """The mesh-pool bound formula pinned: row buckets x window
+    buckets (+ the replay chunk rung when outside the ladder span) x
+    the two input-sharding signatures."""
+    bounds = ladder_bounds(16, 32, 16, 64, executor="scan",
+                           donate=False, pool_capacity=256,
+                           pool_rows=2)
+    rb = _pow2_span(1, 2)             # 2
+    n_windows = _pow2_span(16, 32) + 1  # chunk=64 outside [16, 32]
+    assert bounds["mesh_pool"] == rb * n_windows * 2
+    assert bounds["mesh_move"] == rb * 2
+    # the migration handoff donates by BACKEND, not by the sidecar
+    # donate flag (shard_moves.migrate_rows), so the donating form's
+    # bound holds even with donate=False — on TPU every migration
+    # compiles it while CPU CI leaves it cold
+    assert bounds["mesh_move_pingpong"] == rb * 2
+    assert bounds["compact"] == _pow2_span(16, 64) + rb * 2
+    donating = ladder_bounds(16, 32, 16, 64, donate=True,
+                             pool_capacity=256, pool_rows=2)
+    assert donating["mesh_move_pingpong"] == rb * 2
+    # no pool attached -> no mesh roots in the bound map
+    assert "mesh_pool" not in ladder_bounds(16, 32, 16, 64)
+
+
+def test_prewarm_covers_mesh_pool_admission_compiles(
+        sanitizer, cold_mesh_caches):
+    """With a docs mesh attached, prewarm walks the mesh pool's
+    dispatch programs (both window floors x both sharding signatures
+    + the migration gather), so the FIRST pool admission and its
+    incremental tails compile NOTHING mid-serve."""
+    from fluidframework_tpu.parallel import make_mesh
+
+    sidecar = TpuMergeSidecar(
+        max_docs=2, capacity=16, max_capacity=16, executor="scan",
+        donate=False, seq_mesh=make_mesh(jax.devices()[:2]),
+        pool_capacity=64, ladder=BucketLadder(16, 16),
+    )
+    sidecar.prewarm()
+    jitsan.reset()
+    server = LocalServer()
+    _, s = _drive(server, sidecar, "doc", n=24)
+    assert sidecar.pooled_docs() == 1, "traffic must exercise the pool"
+    assert sidecar.text("doc", "d", "s") == s.get_text()
+    counts = sanitizer.compile_counts()
+    assert all(n == 0 for n in counts.values()), (
+        f"mid-serve compiles after prewarm: "
+        f"{ {r: n for r, n in counts.items() if n} }"
+    )
 
 
 # ======================================================================
@@ -332,6 +471,82 @@ def test_keyword_live_args_alias_check_and_survive(sanitizer):
     assert trips and trips[0].root == "apply_window_pingpong"
     np.asarray(table.length)  # still readable: not deleted
     jitsan.reset()  # the trip was deliberate; clear it for the guard
+
+
+def test_migration_handoff_source_reads_trap(sanitizer):
+    """The migration handoff (ops/shard_moves.migrate_rows) consumes
+    its SOURCE table; jitsan makes a later read raise on any backend
+    — a migration that kept reading the pre-move table would pass on
+    CPU (donation ignored) and detonate on-chip."""
+    from fluidframework_tpu.ops.shard_moves import (
+        migrate_rows,
+        take_rows,
+    )
+
+    table = make_table(4, 32)
+    perm = np.arange(4, dtype=np.int32)[::-1].copy()
+    out = migrate_rows(table, perm)
+    assert [e.root for e in sanitizer.donation_events()] == [
+        "mesh_move_pingpong"]
+    with pytest.raises(RuntimeError, match="deleted"):
+        # the deliberate post-handoff read the trap exists to catch
+        np.asarray(table.length)  # fluidlint: disable=donated-buffer-reuse
+    np.asarray(out.length)  # the permuted output stays readable
+    assert sanitizer.trips() == []
+    # the PLAIN gather is the non-consuming form: source stays live
+    jitsan.reset()
+    kept = take_rows(out, np.arange(4, dtype=np.int32))
+    np.asarray(out.length)
+    np.asarray(kept.length)
+    assert sanitizer.donation_events() == []
+
+
+def test_mesh_pool_migration_under_sanitizer_never_rereads(sanitizer):
+    """The pool's own migration discipline end to end under the
+    sanitizer: a driven hot-spot migration consumes the pre-move
+    table (a mesh_move donation event fires), no aliasing trip
+    fires, and every member's text stays bit-correct afterwards —
+    the runtime half of the 'migration handoff buffers must not
+    read-after-donate' contract."""
+    from fluidframework_tpu.parallel import make_mesh
+
+    sidecar = TpuMergeSidecar(
+        max_docs=4, capacity=16, max_capacity=16, executor="scan",
+        donate=False, seq_mesh=make_mesh(jax.devices()[:2]),
+        pool_capacity=256, ladder=BucketLadder(16, 32),
+    )
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    docs = {}
+    for i in range(3):
+        doc = f"doc-{i}"
+        sidecar.subscribe(server, doc, "d", "s")
+        c = Container.load(factory.create_document_service(doc),
+                           client_id=f"{doc}-w")
+        s = c.runtime.create_datastore("d").create_channel(
+            "sharedstring", "s")
+        for _ in range(20):
+            s.insert_text(0, "abcdefgh")
+            c.flush()
+        docs[doc] = (c, s)
+    sidecar.apply()
+    sidecar.sync()
+    for _ in range(5):
+        for doc, (c, s) in docs.items():
+            n = 10 if doc == "doc-0" else 1
+            for _ in range(n):
+                s.insert_text(0, "XY")
+            c.flush()
+        sidecar.apply()
+        sidecar.sync()
+    assert sidecar._pool.migration_count > 0
+    assert sanitizer.trips() == []
+    assert any(
+        e.root == "mesh_move_pingpong"
+        for e in sanitizer.donation_events()
+    ), "the migration handoff must consume the pre-move table"
+    for doc, (c, s) in docs.items():
+        assert sidecar.text(doc, "d", "s") == s.get_text(), doc
 
 
 def test_sidecar_donate_path_retires_fodder_loudly(sanitizer):
