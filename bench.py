@@ -42,7 +42,7 @@ import time
 
 STAGES = ("probe", "fuzz", "config1", "config2", "config3", "config4",
           "config5", "config6", "config7", "config8", "config9",
-          "config10")
+          "config10", "config11")
 
 # Machine-readable corpus identity, stamped into EVERY stage record
 # (r5 silently changed the stream mix — flow-mix quarter joined — and
@@ -64,6 +64,7 @@ STAGE_CORPUS = {
     "config8": {"generator": "overload-mix", "version": 1},
     "config9": {"generator": "open-loop-poisson", "version": 1},
     "config10": {"generator": "mesh-hotspot", "version": 1},
+    "config11": {"generator": "chaos-standard", "version": 1},
 }
 
 
@@ -2033,6 +2034,93 @@ def stage_config10(scale: str, reps: int, cooldown: float) -> dict:
     }
 
 
+def stage_config11(scale: str, reps: int, cooldown: float) -> dict:
+    """Robustness under chaos (docs/ROBUSTNESS.md): the seeded fault
+    storm over the real AlfredServer dispatch path — steady phase,
+    then the standard schedule armed at EVERY registered injection
+    site, then recovery — reporting the goodput DIP during the storm
+    and the RECOVERY TIME back to the steady SLO floor (>=95% rolling
+    goodput held for a full window), both on the step clock, so
+    robustness regressions show up as BENCH_* deltas next to
+    metrics_delta/fluidlint_findings. A convergence leg runs two
+    seeded schedules (one with a full crash-restart + torn state)
+    against the fault-free oracle and asserts bit-equality — a bench
+    round with a divergent chaos run must FAIL, not record it."""
+    from fluidframework_tpu.testing.chaos import (
+        crash_plan,
+        run_chaos,
+        run_chaos_storm,
+    )
+
+    steps, storm = {
+        "full": (240, (80, 160)),
+        "cpu": (120, (40, 80)),
+        "smoke": (60, (20, 40)),
+    }[scale]
+
+    # --- storm leg: goodput dip + recovery time ----------------------
+    t0 = time.perf_counter()
+    storm_rep = run_chaos_storm(seed=11, steps=steps, storm=storm)
+    storm_wall = time.perf_counter() - t0
+    assert storm_rep.converged, (
+        f"config11 storm diverged: {storm_rep.failures}")
+    # run-to-run determinism on the step clock (config9 discipline)
+    again = run_chaos_storm(seed=11, steps=steps, storm=storm)
+    assert again.deterministic_fields() == \
+        storm_rep.deterministic_fields(), (
+            "config11 determinism violation: "
+            f"{again.deterministic_fields()} != "
+            f"{storm_rep.deterministic_fields()}")
+
+    # --- convergence leg: seeded differential vs the oracle ----------
+    oracle = run_chaos(0, faults=False)
+    assert oracle.converged, oracle.failures
+    diff = []
+    # seed 3: odd => crash-restart, and crash_plan(3) tears the
+    # checkpoint tmp — the torn-state leg the docstring promises
+    for seed in (0, 3):
+        rep = run_chaos(seed)
+        assert rep.converged and \
+            rep.alpha_text == oracle.alpha_text and \
+            rep.beta_text == oracle.beta_text, (
+                f"config11 convergence differential FAILED for seed "
+                f"{seed} (reproduce: run_chaos({seed})): "
+                f"{rep.failures}")
+        diff.append({
+            "seed": seed,
+            "fired": len(rep.fired),
+            "crashes": rep.crashes,
+            "tear": rep.tear,
+            "tear_applied": rep.tear_applied,
+            "sidecar_tier": rep.sidecar_tier,
+        })
+    assert any(d["crashes"] for d in diff) and \
+        any(d["tear_applied"] for d in diff), (
+            "config11's crash seed must crash-restart WITH a torn "
+            "state ACTUALLY applied "
+            f"(crash_plan: {crash_plan(3, 40)}, runs: {diff})")
+
+    return {
+        "steps": steps,
+        "storm_window": list(storm),
+        "offered_ops": storm_rep.offered_ops,
+        "acked_ops": storm_rep.acked_ops,
+        "goodput_steady": round(storm_rep.goodput_steady, 4),
+        "goodput_dip": round(storm_rep.goodput_dip, 4),
+        "recovery_steps": storm_rep.recovery_steps,
+        "recovery_time_s": storm_rep.recovery_time_s,
+        "faults_fired": storm_rep.fired,
+        "chaos_counts": storm_rep.chaos_counts,
+        "convergence_runs": diff,
+        "kernel_ops_per_sec": round(
+            storm_rep.acked_ops / max(storm_wall, 1e-9), 1),
+        "wall_s": round(storm_wall, 3),
+        "deterministic": "step clock, seeded schedule, x2 storm "
+                         "runs bit-equal; convergence leg asserts "
+                         "oracle equality (incl. one crash-restart)",
+    }
+
+
 STAGE_FNS = {
     "probe": stage_probe,
     "fuzz": stage_fuzz,
@@ -2046,6 +2134,7 @@ STAGE_FNS = {
     "config8": stage_config8,
     "config9": stage_config9,
     "config10": stage_config10,
+    "config11": stage_config11,
 }
 
 

@@ -46,9 +46,11 @@ ALLOWED = {
     # sits on the datastore runtime, sharedObject.ts:42)
     "ops": {"models", "protocol", "utils"},
     "runtime": {"obs", "protocol", "utils"},
-    "drivers": {"obs", "protocol", "service", "utils"},  # local/socket
     # drivers bind to the in-proc/networked service (local-driver ->
-    # local-server in the reference)
+    # local-server in the reference); qos: the transport seams
+    # register chaos injection sites (qos/faults.py) and honor the
+    # throttle/backoff vocabulary
+    "drivers": {"obs", "protocol", "qos", "service", "utils"},
     "loader": {"drivers", "models", "obs", "protocol", "runtime",
                "utils"},
     "framework": {"drivers", "loader", "models", "runtime",
@@ -57,10 +59,16 @@ ALLOWED = {
                 "utils"},
     "native": {"ops", "protocol", "service", "utils"},
     # obs: the mesh-sharded pool registers its own metric families
-    # (mesh_pool_*) — observation only, obs never imports parallel
-    "parallel": {"obs", "ops", "utils"},
-    "testing": {"models", "obs", "ops", "protocol", "qos", "runtime",
-                "service", "utils"},
+    # (mesh_pool_*) — observation only, obs never imports parallel;
+    # qos: the pool's dispatch/migration seams register chaos
+    # injection sites (qos/faults.py) — injection only, qos never
+    # imports parallel
+    "parallel": {"obs", "ops", "qos", "utils"},
+    # drivers/loader: the chaos harness (testing/chaos.py) drives real
+    # Containers over the real ingress dispatch path — the client
+    # stack is what it exercises
+    "testing": {"drivers", "loader", "models", "obs", "ops",
+                "protocol", "qos", "runtime", "service", "utils"},
     "tools": {"drivers", "loader", "models", "obs", "ops", "protocol",
               "qos", "runtime", "service", "testing", "utils"},
 }

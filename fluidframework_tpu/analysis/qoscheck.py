@@ -18,6 +18,22 @@ still fires on test fixtures placed under a ``service/`` tmp dir.
 ``queue.Queue()`` (the threading one) counts too — the driver layer
 uses it legitimately, but in the service plane it has the same
 unbounded-buffer failure mode.
+
+``retry-without-jitter``: a ``time.sleep(<constant>)`` inside a
+retry/reconnect loop in a ``drivers``/``service``/``qos`` path
+component synchronizes every client the service just shed — after a
+mass disconnect (exactly what a chaos storm injects) they all come
+back at t+delay, t+2*delay, ... in lockstep, re-creating the spike
+that caused the shedding (the thundering herd). Backoff delays must
+route through ``drivers/driver_utils.full_jitter_delay`` (which also
+honors a throttle's ``retry_after_seconds`` as the floor).
+Flagged: a constant argument (directly, via constant arithmetic, or
+via a local name bound to one) slept inside a ``for``/``while`` body.
+Clean: the slept value flows from a ``full_jitter_delay(...)`` call
+(directly or via a local name). Unknown provenance (parameters,
+attributes, other calls) is trusted — the arithmetic-with-names
+backoff (``base * 2 ** attempt``: exponential but unjittered) is a
+documented false negative; route it through the helper anyway.
 """
 from __future__ import annotations
 
@@ -117,10 +133,132 @@ def _qualname_of(stack: list[str], node: ast.Call,
     return f"{scope}.{target}" if target else scope
 
 
+JITTER_HELPER = "full_jitter_delay"
+
+
+def _in_retry_scope(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return any(p in ("drivers", "service", "qos") for p in parts[:-1])
+
+
+def _is_sleep_call(node: ast.Call, aliases: dict) -> bool:
+    dotted = _dotted(node.func, aliases)
+    if dotted is None:
+        return False
+    return dotted == "time.sleep" or dotted.endswith(".time.sleep") \
+        or dotted == "sleep" and aliases.get("sleep", "") == "time.sleep"
+
+
+def _derives_from_jitter(value: ast.AST, env: dict) -> bool:
+    """Does the expression (or a local name it reads) flow from a
+    full_jitter_delay(...) call?"""
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            callee = node.func
+            name = callee.attr if isinstance(callee, ast.Attribute) \
+                else getattr(callee, "id", None)
+            if name == JITTER_HELPER:
+                return True
+        if isinstance(node, ast.Name) and node.id in env:
+            if env[node.id] == "jitter":
+                return True
+    return False
+
+
+def _const_only(value: ast.AST, env: dict) -> bool:
+    """True when every leaf is a literal constant or a local name
+    bound to one — the deterministic-schedule shape the rule exists
+    to flag."""
+    for node in ast.walk(value):
+        if isinstance(node, (ast.BinOp, ast.UnaryOp, ast.Constant,
+                             ast.operator, ast.unaryop, ast.expr_context)):
+            continue
+        if isinstance(node, ast.Name):
+            if env.get(node.id) != "const":
+                return False
+            continue
+        return False
+    return True
+
+
+def _check_retry_jitter(src: SourceFile, aliases: dict,
+                        module: str, findings: list) -> None:
+    # Class.method qualnames so same-named methods of two classes
+    # never share a finding key (the shapecheck-review lesson)
+    quals: dict[ast.AST, str] = {}
+    for cls in ast.walk(src.tree):
+        if isinstance(cls, ast.ClassDef):
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    quals[item] = f"{cls.name}.{item.name}"
+    for scope in ast.walk(src.tree):
+        if not isinstance(scope, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef,
+                                  ast.Module)):
+            continue
+        # textual-order local provenance: name -> "const" | "jitter"
+        # (later bindings supersede; anything else drops the name)
+        env: dict[str, str] = {}
+        hits = 0
+        own_body = list(ast.iter_child_nodes(scope))
+
+        def walk(node, in_loop: bool, owner) -> None:
+            nonlocal hits
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and node is not owner:
+                return  # nested scopes analyzed on their own walk
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if _derives_from_jitter(node.value, env):
+                    env[name] = "jitter"
+                elif _const_only(node.value, env):
+                    env[name] = "const"
+                else:
+                    env.pop(name, None)
+            if isinstance(node, ast.Call) and in_loop \
+                    and _is_sleep_call(node, aliases) and node.args:
+                arg = node.args[0]
+                if not _derives_from_jitter(arg, env) \
+                        and _const_only(arg, env):
+                    hits += 1
+                    qual = quals.get(
+                        owner, getattr(owner, "name", "<module>"))
+                    suffix = "" if hits == 1 else str(hits)
+                    findings.append(Finding(
+                        rule="retry-without-jitter",
+                        path=src.relpath, line=node.lineno,
+                        message=(
+                            "constant sleep in a retry/reconnect "
+                            "loop: a fixed delay synchronizes every "
+                            "shed client's comeback (thundering "
+                            "herd) — route the delay through "
+                            "driver_utils.full_jitter_delay "
+                            "(docs/ROBUSTNESS.md)"
+                        ),
+                        key=f"{module}:{qual}.sleep{suffix}",
+                    ))
+            loops_here = in_loop or isinstance(node,
+                                               (ast.For, ast.While))
+            for child in ast.iter_child_nodes(node):
+                walk(child, loops_here, owner)
+
+        for child in own_body:
+            walk(child, False, scope)
+
+
 def check(files: list[SourceFile]) -> list[Finding]:
     findings: list[Finding] = []
     for src in files:
-        if src.tree is None or not _in_scope(src.relpath):
+        if src.tree is None:
+            continue
+        if _in_retry_scope(src.relpath):
+            _check_retry_jitter(
+                src, _import_aliases(src.tree),
+                src.relpath.rsplit("/", 1)[-1], findings)
+        if not _in_scope(src.relpath):
             continue
         aliases = _import_aliases(src.tree)
         module = src.relpath.rsplit("/", 1)[-1]

@@ -30,6 +30,7 @@ from __future__ import annotations
 import itertools
 import json
 import queue
+import select
 import socket
 import struct
 import sys
@@ -42,6 +43,15 @@ from ..obs.trace import stamp as trace_stamp
 from ..protocol.messages import DocumentMessage, Nack, NackErrorType, SequencedMessage
 from ..protocol.constants import wire_version_lt
 from ..protocol.serialization import decode_contents, message_from_json
+from ..qos.faults import (
+    KIND_DELAY,
+    KIND_DISCONNECT,
+    KIND_DROP,
+    KIND_DUPLICATE,
+    KIND_NACK,
+    KIND_REORDER,
+    PLANE as _CHAOS,
+)
 from ..service.ingress import document_message_to_json, pack_frame
 
 _LEN = struct.Struct(">I")
@@ -56,6 +66,22 @@ _DISPATCH_FAULTS = obs_metrics.REGISTRY.counter(
 _REQUEST_TIMEOUTS = obs_metrics.REGISTRY.counter(
     "driver_request_timeouts_total",
     "request/response deadlines missed (flight dump emitted)")
+
+# chaos seams (docs/ROBUSTNESS.md): the SAME site names the in-proc
+# chaos transport (testing/chaos.py) registers, so one schedule
+# drives either harness. Outbound faults are the ones a real TCP
+# stream can actually exhibit at this layer — transport death and an
+# injected throttle nack (the faultInjectionDriver vocabulary);
+# inbound faults apply to broadcast "op" frames only, where
+# drop/duplicate/reorder are REAL phenomena with real recovery paths
+# (slow-consumer fanout drops -> gap refetch; catch-up overlapping
+# live fanout -> the container's seq dedupe). rid-paired
+# request/response frames ride the reliable stream untouched.
+_SITE_FRAME_OUT = _CHAOS.site(
+    "socket.frame_out", (KIND_DISCONNECT, KIND_NACK))
+_SITE_FRAME_IN = _CHAOS.site(
+    "socket.frame_in",
+    (KIND_DROP, KIND_DUPLICATE, KIND_REORDER, KIND_DELAY))
 
 
 # wire versions this driver speaks, newest first (the server echoes
@@ -125,6 +151,9 @@ class SocketDocumentService:
             128, name=f"socket-{document_id}")
         self.last_flight_dump: Optional[str] = None
         self._inbox: queue.Queue[Optional[dict]] = queue.Queue()
+        # broadcast frames a chaos reorder/delay fault is holding
+        # (recv-pump thread only; released after the next delivery)
+        self._held: list[dict] = []
         self._pump = threading.Thread(
             target=self._recv_loop, daemon=True,
             name=f"socket-recv-{document_id}",
@@ -139,6 +168,31 @@ class SocketDocumentService:
     # -- framing -------------------------------------------------------
 
     def _send(self, data: dict) -> None:
+        if data.get("type") == "submitOp":
+            fault = _SITE_FRAME_OUT.fire(doc=self.document_id)
+            if fault == KIND_NACK:
+                # refused as a throttling service would: the frame is
+                # dropped and an injected nack delivers on the normal
+                # dispatch path — reconnect + pending-resubmit is the
+                # recovery (faultInjectionDriver.ts:62 semantics)
+                self.flight.record("chaos-nack", type="submitOp")
+                self._inbox.put({
+                    "type": "nack",
+                    "document_id": self.document_id,
+                    "operation": None,
+                    "sequence_number": 0,
+                    "error_type": int(NackErrorType.THROTTLING),
+                    "message": "chaos: injected nack",
+                    "retry_after_seconds": 0.0,
+                })
+                return
+            if fault == KIND_DISCONNECT:
+                # transport death, no goodbye: the frame is lost to
+                # the dying socket; the recv pump's teardown protocol
+                # runs and the app-level reconnect path recovers
+                self.flight.record("chaos-disconnect")
+                self.close()
+                return
         frame = pack_frame(data)
         self.flight.record("send", type=data.get("type"),
                            rid=data.get("rid"), bytes=len(frame))
@@ -158,10 +212,36 @@ class SocketDocumentService:
             buf += chunk
         return buf
 
+    # how long a chaos-held (reordered/delayed) frame may wait for a
+    # NEXT frame before it releases anyway: a held frame on an IDLE
+    # connection would otherwise stall the replica until the socket
+    # timeout (gap detection needs follow-on traffic to notice)
+    HELD_FLUSH_S = 0.05
+
+    def _recv_header(self) -> Optional[bytes]:
+        """Read the next frame header. While chaos-held frames exist,
+        poll READABILITY with ``select`` and flush the holds if the
+        wire stays idle — never by toggling the socket timeout, which
+        is shared with concurrent ``sendall`` on the submit path (a
+        50ms send timeout could tear an outbound frame mid-write and
+        desync the whole length-prefixed stream)."""
+        while self._held:
+            try:
+                readable, _, _ = select.select(
+                    [self._sock], [], [], self.HELD_FLUSH_S)
+            except (OSError, ValueError):
+                return None  # socket died under us
+            if readable:
+                break  # real traffic follows: the reorder resolves
+            for held in self._held:
+                self._inbox.put(held)
+            self._held = []
+        return self._recv_exact(_LEN.size)
+
     def _recv_loop(self) -> None:
         try:
             while not self._closed:
-                header = self._recv_exact(_LEN.size)
+                header = self._recv_header()
                 if header is None:
                     break
                 (length,) = _LEN.unpack(header)
@@ -196,12 +276,41 @@ class SocketDocumentService:
                     # TimeoutError instead of a prompt PermissionError
                     self._on_connect_error(frame)
                 else:
+                    if kind == "op":
+                        fault = _SITE_FRAME_IN.fire(
+                            doc=self.document_id)
+                        if fault == KIND_DROP:
+                            # the slow-consumer shape: the fanout
+                            # frame vanishes; the container's gap
+                            # detection refetches it from delta
+                            # storage
+                            continue
+                        if fault == KIND_DUPLICATE:
+                            # at-least-once shape: the container's
+                            # inbound seq check drops the copy
+                            self._inbox.put(frame)
+                        elif fault in (KIND_REORDER, KIND_DELAY):
+                            # held past the next delivered frame:
+                            # out-of-order arrival — gap refetch +
+                            # seq dedupe absorb it
+                            self._held.append(frame)
+                            continue
                     self._inbox.put(frame)
+                    if self._held:
+                        for held in self._held:
+                            self._inbox.put(held)
+                        self._held = []
         finally:
             # even on a parse error the shutdown protocol must run, or
             # the dispatcher and every pending request hang
             self.flight.record("transport-closed")
             self._closed = True
+            for held in self._held:
+                # chaos-held frames still deliver (late, like the
+                # reordered arrivals they model) — held-forever would
+                # be a silent drop without the drop accounting
+                self._inbox.put(held)
+            self._held = []
             self._inbox.put(None)
             with self._pending_lock:
                 waiters = list(self._pending.values())

@@ -256,12 +256,35 @@ class Container(EventEmitter):
         assert not self.closed
         if self.connected:
             return
+        if self.runtime.connected:
+            # the transport died WITHOUT a clean disconnect (socket
+            # death, injected disconnect, service crash): the runtime
+            # never observed the drop, and set_connection_state(True)
+            # below would see connected->connected and SKIP the
+            # pending replay — stranding every pending op as a
+            # permanent orphan at the front of the pending queue
+            # (every later ack then pops the wrong entry — found by
+            # the chaos crash-recovery differential as a merge-tree
+            # "pending queue out of order" assert three hops
+            # downstream). Align the runtime with reality first.
+            self.runtime.set_connection_state(False)
         # stale queued messages would double-process after the direct
         # catch-up below; they are all in the op log and get refetched
         self._clear_inbound_state()
         # catch up anything missed while disconnected, THEN attach the
         # live stream (CatchingUp -> Connected, connectionStateHandler)
-        for msg in self.service.read_ops(self.last_processed_seq):
+        catchup = self.service.read_ops(self.last_processed_seq)
+        if catchup and catchup[0].sequence_number > \
+                self.last_processed_seq + 1:
+            # a summary ack truncated the op log past this replica's
+            # position while it was offline: exact catch-up is
+            # impossible — say so actionably instead of tripping the
+            # contiguity assert mid-replay (found by the chaos
+            # differential: a client disconnected across a summary
+            # window hit the bare assert on reconnect). Same error
+            # (and ONE wording) as the gap-refetch path's check.
+            raise self._truncation_error(catchup[0].sequence_number)
+        for msg in catchup:
             self._process(msg)
         self._connection = self.service.connect_to_delta_stream(
             self.client_id, self._on_message, self._on_nack
@@ -322,17 +345,40 @@ class Container(EventEmitter):
             return  # duplicate delivery
         if msg.sequence_number > self._last_enqueued_seq() + 1:
             # gap: fetch the missing range from delta storage
-            # (deltaManager.ts:883 fetchMissingDeltas)
+            # (deltaManager.ts:883 fetchMissingDeltas). Contiguity is
+            # checked per refetched op AND at the end: a log the
+            # service truncated above this replica's position (a
+            # summary ack while we were behind) can come back empty
+            # OR with only the post-truncation suffix — either way
+            # the gap is unfillable, and enqueuing would trip the
+            # bare contiguity assert downstream. Fail loudly and
+            # actionably instead (same contract as the
+            # connect()-time check, which cannot catch this when no
+            # ops trail the truncation yet).
             for missing in self.service.read_ops(
                 self._last_enqueued_seq(), msg.sequence_number - 1
             ):
+                if missing.sequence_number > \
+                        self._last_enqueued_seq() + 1:
+                    raise self._truncation_error(
+                        missing.sequence_number)
                 self._enqueue_inbound(missing)
+            if msg.sequence_number > self._last_enqueued_seq() + 1:
+                raise self._truncation_error(msg.sequence_number)
         self._enqueue_inbound(msg)
         if not self.inbound_paused:
             self._scheduler.drain()
 
     def _last_enqueued_seq(self) -> int:
         return max(self.last_processed_seq, self._enqueued_seq)
+
+    def _truncation_error(self, got_seq: int) -> RuntimeError:
+        return RuntimeError(
+            f"op stream gap {self._last_enqueued_seq() + 1}.."
+            f"{got_seq - 1} is not in delta storage (truncated by a "
+            "summary): this replica cannot catch up exactly — "
+            "reload from the latest summary (Container.load)"
+        )
 
     def _enqueue_inbound(self, msg: SequencedMessage) -> None:
         self._enqueued_seq = msg.sequence_number

@@ -80,8 +80,18 @@ from ..ops.segment_table import (
     make_table,
 )
 from ..ops.shard_moves import migrate_rows
+from ..qos.faults import KIND_DEFER, PLANE as _CHAOS
 from .mesh import DOC_AXIS
 from .seq_shard import _SHARD_MAP_CHECK_KW, shard_map
+
+# chaos seams (docs/ROBUSTNESS.md), shared by NAME with the seq tier
+# (tpu_sidecar registers the same sites): a deferred pool dispatch
+# leaves tails past the watermark for the next settle; a deferred
+# migration just skips one opportunistic move — both bit-exact by
+# construction, which is exactly what the convergence differential
+# pins
+_SITE_POOL_DISPATCH = _CHAOS.site("sidecar.pool_dispatch", (KIND_DEFER,))
+_SITE_POOL_MIGRATE = _CHAOS.site("sidecar.pool_migrate", (KIND_DEFER,))
 
 # Registry families (process aggregates across every pool instance;
 # exact per-instance counts stay on the owning object — tests read
@@ -381,6 +391,11 @@ class MeshShardedPool:
         document (``_maybe_migrate``)."""
         if self._table is None:
             return []
+        if _SITE_POOL_DISPATCH.fire(tier="mesh") is not None:
+            # deferred: tails stay past the watermark and apply whole
+            # at the next settle — exactly-once by construction (heat
+            # also waits; a lagging dispatch must not decay it)
+            return []
         pending = {}
         depths = {}
         upto = {}
@@ -441,6 +456,10 @@ class MeshShardedPool:
         relocate the hot spot). Wholly deterministic: ties break on
         shard index, then slot id."""
         if self.n_shards < 2 or self._table is None:
+            return
+        if _SITE_POOL_MIGRATE.fire() is not None:
+            # deferred: migration is opportunistic — heat persists, so
+            # a genuinely hot shard re-offers the same move next settle
             return
         loads = self.shard_loads()
         hot = max(range(self.n_shards), key=lambda i: (loads[i], -i))

@@ -18,7 +18,11 @@ plus the standard overload-control trio:
   :mod:`.admission`) — pressure tier x traffic class -> admit or
   shed with an HONEST ``retry_after_seconds``;
 - **Circuit breaker** (:mod:`.breaker`) — closed/open/half-open with
-  probe admission around sidecar dispatch and storage writes.
+  probe admission around sidecar dispatch and storage writes;
+- **Fault plane** (:mod:`.faults`, "fluidchaos") — named injection
+  sites at every recovery seam + seeded replayable fault schedules,
+  the substrate of the crash-recovery convergence differential
+  (docs/ROBUSTNESS.md).
 
 Layering: qos sits beside obs (above protocol); the service plane
 imports it, it imports nothing it protects. Everything is clock-
@@ -28,6 +32,15 @@ injectable so overload behavior pins down in deterministic tests
 from __future__ import annotations
 
 from .admission import AdmissionController, RateLimits, default_limits
+from .faults import (
+    PLANE,
+    FaultPlane,
+    FaultSchedule,
+    InjectionSite,
+    TransientFault,
+    TransientIOFault,
+    standard_rates,
+)
 from .breaker import (
     STATE_CLOSED,
     STATE_HALF_OPEN,
@@ -62,6 +75,13 @@ __all__ = [
     "BreakerOpenError",
     "Budget",
     "CircuitBreaker",
+    "FaultPlane",
+    "FaultSchedule",
+    "InjectionSite",
+    "PLANE",
+    "standard_rates",
+    "TransientFault",
+    "TransientIOFault",
     "CLASS_CATCHUP",
     "CLASS_SUMMARY",
     "CLASS_WRITE",

@@ -49,6 +49,7 @@ from ..obs import metrics as obs_metrics
 from ..obs.trace import stamp as trace_stamp
 from ..protocol.constants import wire_version_lt
 from ..qos import CLASS_CATCHUP, CLASS_SUMMARY, CLASS_WRITE
+from ..qos.faults import KIND_ERROR, PLANE as _CHAOS
 from ..protocol.messages import (
     ClientDetail,
     DocumentMessage,
@@ -112,6 +113,13 @@ _DISPATCH_MS = obs_metrics.REGISTRY.histogram(
     "ingress_dispatch_ms",
     "event-loop occupancy per dispatched frame (decode + ticket + "
     "fanout enqueue)")
+
+# chaos seam (docs/ROBUSTNESS.md): a transient fault on the summary
+# upload plane — fired on the FINAL (rid-waited) chunk so it always
+# reaches the uploader synchronously; the container's summarize
+# fallback degrades to the inline-summary path, which is the recovery
+# this seam exists to keep exercised
+_SITE_UPLOAD = _CHAOS.site("ingress.summary_upload", (KIND_ERROR,))
 
 # Wire-protocol versions this server speaks (newest first). The
 # reference negotiates `versions` on connect_document
@@ -982,6 +990,15 @@ class AlfredServer:
                     "received": chunk_i,
                 })
             return
+        fault = _SITE_UPLOAD.fire(doc=doc)
+        if fault is not None:
+            # the staged chunks are DISCARDED with the failure (a
+            # retry resends the whole upload under a fresh upload_id
+            # — there is no resume protocol); raising here answers
+            # the waited rid with the transient error shape the
+            # driver converts, and the container falls back inline
+            session.uploads.pop(upload_id, None)
+            raise _SITE_UPLOAD.transient(fault)
         session.uploads.pop(upload_id, None)
         summary = decode_contents(json.loads("".join(state["parts"])))
         handle = self.local.get_orderer(doc).summary_store.stage(

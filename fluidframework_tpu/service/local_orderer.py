@@ -77,6 +77,28 @@ class LocalOrderer:
             state = storage.read_checkpoint()
             if state is not None:
                 self.restore(state)
+            if self.sequencer.sequence_number > self.op_log.last_seq:
+                # checkpoint AHEAD of the op log: with the storage
+                # barriers (scriptorium fsyncs its append before the
+                # checkpoint of that dispatch writes) this state is
+                # unreachable from a crash — it means a pre-barrier
+                # data dir or a log that lost a torn tail the
+                # checkpoint saw. The log is the truth the clients
+                # were (never) told: discard the checkpoint and
+                # rebuild from the log alone, loudly. (The scribe
+                # replica needs no reset here: the unconditional
+                # fast-forward below re-anchors it to the rebuilt
+                # sequencer either way.)
+                import sys
+
+                print(
+                    f"orderer[{document_id}]: checkpoint at seq "
+                    f"{self.sequencer.sequence_number} is AHEAD of "
+                    f"the op log (seq {self.op_log.last_seq}); "
+                    "discarding it and fast-forwarding from the log",
+                    file=sys.stderr,
+                )
+                self.sequencer = type(self.sequencer)(document_id)
             # ops sequenced after the last checkpoint write (or with a
             # lost/absent checkpoint entirely) are in the durable log;
             # fast-forward the stream position so new tickets continue

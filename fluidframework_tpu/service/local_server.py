@@ -60,13 +60,18 @@ class LocalServer:
     (document-parallelism — SURVEY §2.9 axis 1)."""
 
     def __init__(self, durable_dir: Optional[str] = None,
-                 storage_breaker=None) -> None:
+                 storage_breaker=None,
+                 checkpoint_every: int = 1) -> None:
         self.documents: dict[str, LocalOrderer] = {}
         self.durable_dir = durable_dir
         # ONE shared qos.CircuitBreaker across every document's
         # checkpoint writes (they share the disk, so they share the
         # failure domain); None = unguarded, as before
         self.storage_breaker = storage_breaker
+        # checkpoint cadence (deli checkpoints every N dispatches): >1
+        # leaves a restart a real op-log gap to fast-forward across —
+        # the crash-recovery path tests/test_chaos.py exercises
+        self.checkpoint_every = checkpoint_every
         self._conn_counter = itertools.count()
 
     def get_orderer(self, document_id: str) -> LocalOrderer:
@@ -83,6 +88,7 @@ class LocalServer:
             self.documents[document_id] = LocalOrderer(
                 document_id, storage=storage,
                 storage_breaker=self.storage_breaker,
+                checkpoint_every=self.checkpoint_every,
             )
         return self.documents[document_id]
 
@@ -111,7 +117,16 @@ class LocalServer:
             conn.on_message(msg)
         )
         if not read_only:
-            orderer.connect(detail or ClientDetail(client_id))
+            try:
+                orderer.connect(detail or ClientDetail(client_id))
+            except Exception:
+                # the client's own delivery callback refused the join
+                # (e.g. the loader's unfillable-gap error): unwind the
+                # half-made connection — a zombie subscription would
+                # keep delivering into the dead client and raise its
+                # error inside every UNRELATED submitter's dispatch
+                conn.disconnect()
+                raise
         return conn
 
     # ------------------------------------------------------------------

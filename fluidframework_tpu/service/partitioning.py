@@ -36,8 +36,21 @@ import zlib
 from typing import Any, Callable, Iterator, Optional
 
 from ..protocol.messages import ClientDetail, DocumentMessage, Nack
+from ..qos.faults import (
+    KIND_DUPLICATE,
+    KIND_ERROR,
+    PLANE as _CHAOS,
+)
 from .local_orderer import LocalOrderer
 from .storage import DocumentStorage
+
+# chaos seams (docs/ROBUSTNESS.md): the consume side replays a record
+# (at-least-once redelivery — deli's clientSequenceNumber dedupe must
+# absorb it); the append side fails transiently (a flaky broker — the
+# producer retries once, mirroring RemoteOrderingQueue's reconnect
+# retry)
+_SITE_APPEND = _CHAOS.site("broker.queue_append", (KIND_ERROR,))
+_SITE_CONSUME = _CHAOS.site("broker.queue_consume", (KIND_DUPLICATE,))
 
 
 def partition_for(document_id: str, n_partitions: int) -> int:
@@ -321,6 +334,16 @@ class Partition:
             nack = self.document(rec.document_id).process(rec.payload)
             if nack is not None and self._on_nack is not None:
                 self._on_nack(rec.document_id, client_id, nack)
+            if (rec.payload.get("kind", "op") == "op"
+                    and _SITE_CONSUME.fire(
+                        offset=rec.offset) is not None):
+                # chaos seam: at-least-once REDELIVERY of the record
+                # (a consumer crash between process and commit replays
+                # it) — deli's clientSequenceNumber dedupe must drop
+                # the duplicate, or the op log's contiguity assert
+                # detonates. Op records only: join/leave are control
+                # records the reference's dedupe does not cover.
+                self.document(rec.document_id).process(rec.payload)
             self.checkpoints.completed(rec.offset)
             self._next_offset = rec.offset + 1
             n += 1
@@ -409,11 +432,19 @@ class PartitionedOrderingService:
                    op: DocumentMessage) -> None:
         from .ingress import document_message_to_json
 
-        self.queue.produce(
-            self.partition_of(document_id), document_id,
-            {"kind": "op", "client_id": client_id,
-             "op": document_message_to_json(op)},
-        )
+        payload = {"kind": "op", "client_id": client_id,
+                   "op": document_message_to_json(op)}
+        partition = self.partition_of(document_id)
+        # chaos seam: a transiently-failing append (flaky broker) is
+        # retried ONCE — the queue mutated nothing when the fault
+        # fired, so the retry is exact (RemoteOrderingQueue's
+        # drop-and-reconnect retry has the same shape); a second
+        # consecutive fault propagates as the loud error it is
+        if _SITE_APPEND.fire(doc=document_id) is not None:
+            if _SITE_APPEND.fire(doc=document_id, retry=True) \
+                    is not None:
+                raise _SITE_APPEND.transient(KIND_ERROR)
+        self.queue.produce(partition, document_id, payload)
 
     # -- consumer side --------------------------------------------------
     def pump(self) -> int:

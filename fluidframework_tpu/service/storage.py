@@ -23,6 +23,23 @@ Design notes (TPU-native build):
   "<path/in/previous/summary>"}; the store resolves handles against
   the previous version at write time, exactly like the service
   expanding incremental summaries against the last acked one.
+
+CRASH ATOMICITY (docs/ROBUSTNESS.md "storage seams"): a crash may
+land mid-write anywhere, so every durable write here either commits
+whole or leaves the previous state intact — the write-temp + fsync +
+rename protocol for the checkpoint (without the fsync, the rename
+can be durable while the data is not, leaving a prefix-truncated
+checkpoint.json that parses as garbage — the exact reordered-write
+crash state "All File Systems Are Not Created Equal" enumerates),
+fsync-per-append for the op log (the ack barrier: the orderer fans
+an op out only after scriptorium's append returns, so a fanned-out
+op is durable by construction), and torn-TAIL tolerance on every
+JSONL load (a crash inside an append leaves a partial last line;
+that op was never fanned out, so discarding it loudly is exact —
+the client still holds it pending and resubmits). A torn line
+ANYWHERE ELSE is real corruption and still fails loudly. The chaos
+plane (qos/faults.py) enumerates these states in
+tests/test_chaos.py + tests/test_durable_storage.py.
 """
 from __future__ import annotations
 
@@ -30,14 +47,97 @@ import dataclasses
 import hashlib
 import json
 import os
+import sys
 import time
 from typing import Any, Optional
 
+from ..obs import metrics as obs_metrics
 from ..protocol.messages import SequencedMessage
 from ..protocol.serialization import message_from_json, message_to_json
+from ..qos.faults import (
+    KIND_ERROR,
+    KIND_ERROR_BURST,
+    KIND_TORN_WRITE,
+    PLANE,
+    TransientIOFault,
+)
 from .lambdas import OpLog
 
 HANDLE_KEY = "__summary_handle__"
+
+_M_TORN = obs_metrics.REGISTRY.counter(
+    "storage_torn_recoveries_total",
+    "torn on-disk states discarded on load (crash recovery)",
+    labelnames=("file",))
+
+# chaos seams (docs/ROBUSTNESS.md): the checkpoint write consults its
+# site per write (error faults exercise the storage breaker); the
+# op-log site exists for the harness's crash-time torn-tail
+# enumeration (force()d, never fired mid-run — a torn append IS a
+# crash, and the process does not survive it)
+_SITE_CHECKPOINT = PLANE.site(
+    "storage.checkpoint_write",
+    (KIND_ERROR, KIND_ERROR_BURST, KIND_TORN_WRITE))
+_SITE_OPLOG = PLANE.site("storage.oplog_append", (KIND_TORN_WRITE,))
+
+
+def atomic_write(path: str, data: str) -> None:
+    """THE crash-atomic write barrier — write-temp + fsync + rename —
+    with ONE owner, so the checkpoint, the op-log rewrite and the
+    versions rewrite cannot silently diverge on the protocol. Without
+    the fsync the rename can become durable before the data, leaving
+    a prefix-truncated file under the FINAL name (the reordered-write
+    crash state the crash-consistency literature enumerates)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    # fsync the DIRECTORY too: without it the rename itself is not
+    # durable — a crash can leave the directory entry pointing at the
+    # pre-rewrite inode while later appends (already fsynced to the
+    # NEW inode, and acked) vanish with it. The reordered-METADATA
+    # sibling of the data state above.
+    try:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic fs
+        return
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def read_jsonl_tolerant(path: str, label: str) -> tuple[list, bool]:
+    """Parse a JSONL file tolerating ONE torn final line (the crash-
+    mid-append state). Returns (parsed rows, tail_was_torn). A
+    malformed line anywhere but the end is corruption, not a crash
+    state — raised, never skipped."""
+    rows: list = []
+    with open(path) as f:
+        lines = f.readlines()
+    stripped = [ln.strip() for ln in lines]
+    for i, line in enumerate(stripped):
+        if not line:
+            continue
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            if any(stripped[i + 1:]):
+                raise ValueError(
+                    f"{label} corrupt at line {i + 1} of {path!r}: "
+                    "a non-tail torn record is not a crash state"
+                )
+            _M_TORN.labels(file=label).inc()
+            print(
+                f"storage: discarding torn {label} tail "
+                f"(line {i + 1} of {path!r}) — crash mid-append; "
+                "the op was never acked, clients resubmit it",
+                file=sys.stderr,
+            )
+            return rows, True
+    return rows, False
 
 
 def _canonical(obj: Any) -> bytes:
@@ -225,27 +325,33 @@ class FileOpLog(OpLog):
         super().__init__()
         self.path = path
         if os.path.exists(path):
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        self._ops.append(
-                            message_from_json(json.loads(line))
-                        )
+            rows, torn = read_jsonl_tolerant(path, "oplog")
+            for row in rows:
+                self._ops.append(message_from_json(row))
+            if torn:
+                # rewrite without the torn tail so a second crash
+                # cannot stack a new append onto a half record
+                self._rewrite()
         self._fh = open(path, "a")
 
     def _persist_append(self, msg: SequencedMessage) -> None:
         self._fh.write(json.dumps(message_to_json(msg)) + "\n")
         self._fh.flush()
+        # the ACK BARRIER: the pipeline fans out (and acks) only after
+        # this returns, so an op any client ever saw sequenced is
+        # durable — the only tearable crash state is an op nobody was
+        # told about (read_jsonl_tolerant discards exactly that)
+        os.fsync(self._fh.fileno())
 
     def _persist_truncate(self) -> None:
         self._fh.close()
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            for m in self._ops:
-                f.write(json.dumps(message_to_json(m)) + "\n")
-        os.replace(tmp, self.path)
+        self._rewrite()
         self._fh = open(self.path, "a")
+
+    def _rewrite(self) -> None:
+        atomic_write(self.path, "".join(
+            json.dumps(message_to_json(m)) + "\n" for m in self._ops
+        ))
 
 
 class DocumentStorage:
@@ -262,13 +368,27 @@ class DocumentStorage:
         self._versions_path = os.path.join(root, "versions.jsonl")
         self.versions: list[SummaryVersion] = []
         if os.path.exists(self._versions_path):
-            with open(self._versions_path) as f:
-                for line in f:
-                    if line.strip():
-                        self.versions.append(
-                            SummaryVersion(**json.loads(line))
-                        )
+            rows, torn = read_jsonl_tolerant(
+                self._versions_path, "versions")
+            self.versions = [SummaryVersion(**row) for row in rows]
+            if torn:
+                # rewrite without the torn tail, like the op log: the
+                # next commit_summary APPENDS, and stacking a fresh
+                # record onto the half line would turn a recoverable
+                # crash state into mid-file corruption at the load
+                # after that
+                atomic_write(self._versions_path, "".join(
+                    json.dumps(dataclasses.asdict(v)) + "\n"
+                    for v in self.versions
+                ))
         self._checkpoint_path = os.path.join(root, "checkpoint.json")
+        # a leftover checkpoint tmp is the crash-between-write-and-
+        # rename state: the rename never happened, so the committed
+        # checkpoint (or its absence) is the truth — clear the debris
+        try:
+            os.remove(self._checkpoint_path + ".tmp")
+        except OSError:
+            pass
 
     # summaries
     def write_summary(self, sequence_number: int,
@@ -284,6 +404,8 @@ class DocumentStorage:
         self.versions.append(version)
         with open(self._versions_path, "a") as f:
             f.write(json.dumps(dataclasses.asdict(version)) + "\n")
+            f.flush()
+            os.fsync(f.fileno())  # ack barrier, like the op log
         return root
 
     def latest_summary(self) -> Optional[tuple[int, dict]]:
@@ -294,13 +416,36 @@ class DocumentStorage:
 
     # service checkpoint (deli/checkpointContext.ts)
     def write_checkpoint(self, state: dict) -> None:
-        tmp = self._checkpoint_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, self._checkpoint_path)
+        fault = _SITE_CHECKPOINT.fire(doc=os.path.basename(self.root))
+        if fault is not None:
+            # both error kinds surface as the OSError shape the
+            # storage breaker's recovery contract is keyed on; the
+            # torn states themselves are enumerated at crash time by
+            # the harness, not mid-run (a torn write IS a crash)
+            raise TransientIOFault(
+                f"chaos[storage.checkpoint_write]: injected {fault}")
+        # the shared barrier (see atomic_write): the torn-final state
+        # this rules out is exactly what read_checkpoint used to
+        # parse as garbage
+        atomic_write(self._checkpoint_path, json.dumps(state))
 
     def read_checkpoint(self) -> Optional[dict]:
         if not os.path.exists(self._checkpoint_path):
             return None
         with open(self._checkpoint_path) as f:
-            return json.load(f)
+            raw = f.read()
+        try:
+            return json.loads(raw)
+        except ValueError:
+            # a torn/garbage checkpoint must degrade, not detonate:
+            # the op log holds every sequenced op, and the orderer's
+            # restore path fast-forwards from seq 0 when no
+            # checkpoint loads — slower startup, never wrong state
+            _M_TORN.labels(file="checkpoint").inc()
+            print(
+                f"storage: checkpoint {self._checkpoint_path!r} is "
+                f"torn/unparseable ({len(raw)} bytes); ignoring it — "
+                "restart fast-forwards from the op log",
+                file=sys.stderr,
+            )
+            return None

@@ -80,6 +80,12 @@ from ..ops.merge_chunk import (
 from ..ops.merge_kernel import apply_window_pingpong
 from ..ops.segment_table import KIND_NOOP
 from ..protocol.messages import MessageType, SequencedMessage
+from ..qos.faults import (
+    KIND_DEFER,
+    KIND_ERROR,
+    KIND_ERROR_BURST,
+    PLANE as _CHAOS,
+)
 
 # CHUNK_K, _pack_rows and _replay_chunked live in ops/ since the
 # mesh-pool PR (merge_chunk.CHUNK_K, host_bridge.pack_rows /
@@ -130,6 +136,21 @@ _M_POOL_ROUTE_FALLBACK = obs_metrics.REGISTRY.counter(
     "pool_route_fallback_total",
     "SeqShardedPool chunked-route requests served by the "
     "scan-collective executor on a real seq mesh")
+_M_DUP_DROPS = obs_metrics.REGISTRY.counter(
+    "sidecar_duplicate_drops_total",
+    "already-ingested sequenced messages dropped by the per-document "
+    "sequence-number check (at-least-once delivery upstream)")
+
+# chaos seams (docs/ROBUSTNESS.md): the dispatch site fires BEFORE the
+# round mutates anything (queues intact, so a retry is exact); the
+# pool sites model a lagging pool dispatch / a deferred migration / a
+# transiently-failing admission — every one a recovery path the
+# convergence differential must hold through
+_SITE_DISPATCH = _CHAOS.site(
+    "sidecar.dispatch", (KIND_ERROR, KIND_ERROR_BURST))
+_SITE_POOL_DISPATCH = _CHAOS.site("sidecar.pool_dispatch", (KIND_DEFER,))
+_SITE_POOL_ADMIT = _CHAOS.site("sidecar.pool_admit", (KIND_ERROR,))
+_SITE_POOL_MIGRATE = _CHAOS.site("sidecar.pool_migrate", (KIND_DEFER,))
 
 
 def default_executor() -> str:
@@ -329,6 +350,10 @@ class SeqShardedPool:
         calling this at any point after any mix of rebuilds and
         incremental dispatches is exactly-once by construction."""
         if self._table is None:
+            return []
+        if _SITE_POOL_DISPATCH.fire(tier="seq") is not None:
+            # deferred: tails stay past the watermark and apply whole
+            # at the next settle — exactly-once by construction
             return []
         from ..ops.host_bridge import coalesce_noops
 
@@ -585,6 +610,9 @@ class TpuMergeSidecar:
         # message per document — scanning every tracked channel there
         # was accidentally O(docs) per message (O(docs^2) per window)
         self._doc_slots: dict[str, list[tuple[int, str, str]]] = {}
+        # per-document last ingested seq (the at-least-once dedupe
+        # guard in ingest)
+        self._last_ingested: dict[str, int] = {}
         # the encoded stream is the single canonical per-doc history:
         # grow re-replays it on device, eviction decodes it back into
         # sequenced messages for the scalar replica (no duplicate raw
@@ -649,7 +677,22 @@ class TpuMergeSidecar:
     def ingest(self, document_id: str, msg: SequencedMessage) -> None:
         """Consume one sequenced message of a document: channel ops for
         tracked channels encode as kernel ops; everything else becomes
-        a NOOP that still advances the collab window."""
+        a NOOP that still advances the collab window.
+
+        AT-LEAST-ONCE GUARD: a message at/below the document's last
+        ingested sequence number is a duplicate delivery (a chaos-
+        duplicated frame, a replayed broker record, an overlapping
+        catch-up) and is DROPPED here — without this check a
+        duplicate would extend the canonical encoded stream and the
+        pool watermark would faithfully apply the op twice (the
+        watermark dedupes double DISPATCH of the same stream ops, not
+        a double-encoded stream). Same contract as the container's
+        inbound seq check (loader/container.py _on_message)."""
+        last = self._last_ingested.get(document_id, 0)
+        if msg.sequence_number <= last:
+            _M_DUP_DROPS.inc()
+            return
+        self._last_ingested[document_id] = msg.sequence_number
         if self.trace_ops and any(
             slot not in self._host
             for slot, _, _ in self._doc_slots.get(document_id, ())
@@ -834,6 +877,13 @@ class TpuMergeSidecar:
     def _dispatch(self) -> int:
         from ..ops.host_bridge import coalesce_noops
 
+        # chaos seam, BEFORE any mutation: queues are intact, so the
+        # raised transient is exactly a failed device dispatch — the
+        # breaker (when wired) records it, ops stay queued, and the
+        # next apply() retries the identical round
+        fault = _SITE_DISPATCH.fire(queued=self.queued_ops)
+        if fault is not None:
+            raise _SITE_DISPATCH.transient(fault)
         docs = self.max_docs
         t0 = time.perf_counter()
         # HOST HALF — runs while the device still computes the
@@ -1060,7 +1110,7 @@ class TpuMergeSidecar:
         fresh = [s for s in slots if s not in self._pool.row_of]
         # (the admission's full-stream rebuild advances every member's
         # watermark, so nothing it subsumed can dispatch again)
-        failed = self._pool.admit(fresh, self._streams) if fresh else []
+        failed = self._admit_with_retry(fresh) if fresh else []
         admitted = [s for s in slots if s not in failed]
         newly = len([s for s in fresh if s not in failed])
         self.pool_admit_count += newly
@@ -1072,6 +1122,21 @@ class TpuMergeSidecar:
         for slot in admitted:
             self._queued[slot].clear()  # replayed from the stream
         return failed
+
+    def _admit_with_retry(self, fresh: list) -> list:
+        """Pool admission with the chaos seam in front: a transient
+        admission fault (fired BEFORE the pool mutates anything)
+        retries once; a second fault degrades the slots to host
+        eviction — the last-resort tier that always exists — instead
+        of wedging the settle boundary. Served text is identical on
+        every tier, so the degradation is invisible to readers."""
+        for _attempt in (0, 1):
+            fault = _SITE_POOL_ADMIT.fire(slots=len(fresh))
+            if fault is None:
+                return self._pool.admit(fresh, self._streams)
+        self.flight.record("recover-pool-admit-degraded",
+                           slots=len(fresh))
+        return list(fresh)
 
     def _evict(self, slot: int) -> None:
         """Move one document to a host-side scalar oracle replica —

@@ -1,0 +1,1254 @@
+"""fluidchaos harness: seeded fault schedules driven end-to-end
+through the REAL service stack, with a crash-recovery convergence
+differential.
+
+What runs here is not a simulation of the service — it is the real
+thing, single-threaded and deterministic:
+
+- real ``AlfredServer._dispatch`` frames (the serve_bench/overload
+  idiom: ``_ClientSession(server, None)`` driven synchronously, no
+  sockets, no event loop, no timing races);
+- real ``Container``s over a frame-level DocumentService adapter
+  (:class:`ChaosDocumentService`) whose transport seams consult the
+  SAME named injection sites the TCP socket driver registers
+  (``socket.frame_in``/``socket.frame_out`` — one schedule drives
+  either harness);
+- a real ``TpuMergeSidecar`` (tiny ladder, so documents overflow into
+  the pool tier mid-run) subscribed to the server-side broadcaster;
+- real durable storage (op log + checkpoints), so a CRASH-RESTART
+  mid-run rebuilds the whole service from disk: a fresh LocalServer
+  fast-forwards each orderer from its last checkpoint + op log, the
+  sidecar re-ingests the op log, and every client reconnects and
+  resubmits its pending ops.
+
+CRASH-STATE ENUMERATION (PAPERS.md, "All File Systems Are Not Created
+Equal"): a crash may additionally leave a TORN durable state — but
+only one the storage layer's write barriers actually permit. The
+op log fsyncs before the pipeline fans out/acks, so the only
+tearable op-log state is a tail op no client ever saw (the harness
+asserts this before tearing); the checkpoint's write-temp+fsync+
+rename leaves either a torn ``.tmp`` beside an intact checkpoint or
+— enumerating the pre-fix reordered-write state read_checkpoint now
+degrades on — a garbage final file. All three states must recover.
+
+THE DIFFERENTIAL (tests/test_chaos.py): N seeded schedules each run
+the same scripted multi-client workload (three writers sharing a
+text+map document, each editing its OWN marker-delimited region +
+disjoint map keys; one writer driving a sidecar-tracked document into
+the pool tier — conflict-free BY CONSTRUCTION, so the final state is
+interleaving-invariant and the fault-free oracle is well defined) and
+must end bit-identical to the fault-free run: every replica's text,
+signature and map, the late-joining replica loaded fresh from the
+service, the sidecar's served text, a rebuilt-from-op-log shadow
+sidecar, exactly-once pool watermarks, and every acked edit marker
+present exactly once. Any failing seed reproduces from the seed
+alone (`run_chaos(seed)`).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import random
+import shutil
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..loader.container import Container
+from ..obs import metrics as obs_metrics
+from ..protocol.constants import batch_flag
+from ..protocol.messages import (
+    DocumentMessage,
+    Nack,
+    NackErrorType,
+    SequencedMessage,
+)
+from ..protocol.serialization import decode_contents, message_from_json
+from ..qos import CircuitBreaker
+from ..qos.faults import (
+    KIND_DELAY,
+    KIND_DISCONNECT,
+    KIND_DROP,
+    KIND_DUPLICATE,
+    KIND_NACK,
+    KIND_REORDER,
+    KIND_TORN_WRITE,
+    PLANE,
+    FaultSchedule,
+    TransientFault,
+    standard_rates,
+)
+from ..service.ingress import (
+    AlfredServer,
+    _ClientSession,
+    document_message_to_json,
+)
+from ..service.local_server import LocalServer
+
+# the transport sites (registered by name — the socket driver and
+# fault_injection register the same ones)
+_SITE_OUT = PLANE.site("socket.frame_out", (KIND_DISCONNECT, KIND_NACK))
+_SITE_IN = PLANE.site(
+    "socket.frame_in",
+    (KIND_DROP, KIND_DUPLICATE, KIND_REORDER, KIND_DELAY))
+
+
+# ======================================================================
+# frame-level client stack over AlfredServer._dispatch
+
+
+class ChaosTransport:
+    """One client's in-proc 'TCP connection': a real ``_ClientSession``
+    plus the inbound delivery state (held/delayed frames the reorder
+    and delay faults are sitting on). Dies like a socket: marked
+    closed, undelivered frames lost."""
+
+    def __init__(self, server: AlfredServer, name: str):
+        self.server = server
+        self.name = name
+        self.session = _ClientSession(server, None)
+        server._sessions.add(self.session)
+        self.open = True
+        self.inbox: list[dict] = []      # drained, awaiting delivery
+        self.delayed: list[dict] = []    # chaos-delayed to next pump
+
+    def dispatch(self, frame: dict, nbytes: int = 0) -> None:
+        if not self.open:
+            raise ConnectionError(f"{self.name}: transport closed")
+        try:
+            self.server._dispatch(self.session, frame, nbytes)
+        except Exception as e:  # noqa: BLE001 - the server-loop catch
+            # mirror AlfredServer._handle: a dispatch fault answers
+            # with an error frame and the server keeps serving
+            self.session.send({
+                "type": "error",
+                "rid": frame.get("rid"),
+                "error_kind": "permission"
+                if isinstance(e, PermissionError) else "server",
+                "message": f"{type(e).__name__}: {e}",
+            })
+
+    def drain(self) -> None:
+        """Move queued outbound frames into the inbox (rid replies
+        included — request() filters them out before delivery)."""
+        q = self.session.outbound
+        while not q.empty():
+            raw = q.get_nowait()
+            if raw is None:
+                continue
+            self.inbox.append(json.loads(raw[4:]))
+
+    def die(self) -> None:
+        """Transport death: both directions stop, undelivered frames
+        are lost (the server side notices EOF and closes the session,
+        sequencing the client leave — exactly what a dropped TCP
+        connection does)."""
+        if not self.open:
+            return
+        self.open = False
+        self.inbox = []
+        self.delayed = []
+        self.session.close()
+
+    def abandon(self) -> None:
+        """Crash-side death: the SERVER is gone, so nothing sequences
+        a leave — the connection just stops existing."""
+        self.open = False
+        self.inbox = []
+        self.delayed = []
+
+
+class ChaosDeltaConnection:
+    """IDocumentDeltaConnection over chaos frames. Boxcars runtime
+    batches into one submitOp frame (the wire-1.2 contract): a fault
+    then hits the batch ATOMICALLY — a torn batch on the wire is the
+    state the boxcar protocol exists to rule out."""
+
+    def __init__(self, service: "ChaosDocumentService",
+                 client_id: str):
+        self._service = service
+        self.client_id = client_id
+        self.open = True
+        self._batch: list[dict] = []
+        self._batching = False
+
+    def submit(self, op: DocumentMessage) -> None:
+        assert self.open, "submit on closed connection"
+        wire = document_message_to_json(op)
+        flag = batch_flag(op.metadata)
+        if self._batching or flag is True:
+            self._batch.append(wire)
+            self._batching = flag is not False
+            if self._batching:
+                return
+            ops, self._batch = self._batch, []
+            self._submit_frame({"ops": ops})
+            return
+        self._submit_frame({"op": wire})
+
+    def _submit_frame(self, body: dict) -> None:
+        fault = _SITE_OUT.fire(client=self.client_id)
+        if fault == KIND_NACK:
+            # refused as a throttling service would: frame dropped,
+            # nack delivered synchronously (the in-proc LocalServer
+            # nacks synchronously from submit too)
+            self._service._deliver_nack({
+                "operation": None, "sequence_number": 0,
+                "error_type": int(NackErrorType.THROTTLING),
+                "message": "chaos: injected nack",
+                "retry_after_seconds": 0.0,
+            })
+            return
+        if fault == KIND_DISCONNECT:
+            # transport death mid-submit: this frame (and the rest of
+            # the flush) is lost; pending resubmit on reconnect
+            self._service._transport_died()
+            return
+        self._service.transport.dispatch({
+            "type": "submitOp",
+            "document_id": self._service.document_id, **body,
+        })
+
+    def disconnect(self) -> None:
+        if not self.open:
+            return
+        self.open = False
+        self._batch = []
+        self._batching = False
+        transport = self._service.transport
+        if transport is not None and transport.open:
+            try:
+                transport.dispatch({
+                    "type": "disconnect_document",
+                    "document_id": self._service.document_id,
+                })
+            except ConnectionError:
+                pass
+
+
+class ChaosDocumentService:
+    """IDocumentService over AlfredServer._dispatch frames — the
+    socket driver's exact plane vocabulary (connect_document /
+    submitOp / read_ops / fetch_summary / upload_summary_chunk),
+    synchronous and deterministic. One instance per client per
+    document; each connect_to_delta_stream opens a FRESH transport
+    (a reconnect is a new TCP connection)."""
+
+    _rids = itertools.count(1)
+
+    def __init__(self, harness: "ChaosHarness", document_id: str,
+                 client_name: str):
+        self.harness = harness
+        self.document_id = document_id
+        self.client_name = client_name
+        self.transport: Optional[ChaosTransport] = None
+        self.connection: Optional[ChaosDeltaConnection] = None
+        self._on_message = None
+        self._on_nack = None
+
+    # -- transport lifecycle -------------------------------------------
+
+    def _fresh_transport(self) -> ChaosTransport:
+        if self.transport is not None:
+            self.transport.die()
+        self.transport = ChaosTransport(
+            self.harness.server, f"{self.client_name}")
+        return self.transport
+
+    def _transport_died(self) -> None:
+        if self.transport is not None:
+            self.transport.die()
+        if self.connection is not None:
+            self.connection.open = False
+
+    # -- request/response ----------------------------------------------
+
+    def _request(self, frame: dict) -> dict:
+        """One rid-paired request. Broadcast frames encountered while
+        waiting are buffered for the pump — never delivered
+        re-entrantly (the gap-refetch path issues requests from
+        INSIDE a delivery)."""
+        transport = self.transport
+        if transport is None or not transport.open:
+            # the loader reads snapshot + trailing ops BEFORE joining
+            # the delta stream (container.ts load order): storage
+            # requests open the transport on demand, exactly like the
+            # socket driver's connect-time socket
+            transport = self._fresh_transport()
+        rid = next(self._rids)
+        transport.dispatch(dict(frame, rid=rid))
+        transport.drain()
+        reply = None
+        rest = []
+        for f in transport.inbox:
+            if f.get("rid") == rid and reply is None:
+                reply = f
+            else:
+                rest.append(f)
+        transport.inbox[:] = rest
+        if reply is None:
+            raise ConnectionError(
+                f"{self.client_name}: no reply to {frame['type']}")
+        if reply.get("type") == "error":
+            msg = reply.get("message", "server error")
+            if reply.get("error_kind") == "permission":
+                raise PermissionError(msg)
+            if reply.get("error_kind") == "throttle":
+                from ..drivers.driver_utils import RetriableError
+
+                raise RetriableError(msg, retry_after_seconds=reply.get(
+                    "retry_after_seconds"))
+            raise RuntimeError(msg)
+        return reply
+
+    # -- DocumentService surface ---------------------------------------
+
+    def connect_to_delta_stream(self, client_id, on_message,
+                                on_nack=None) -> ChaosDeltaConnection:
+        self._on_message = on_message
+        self._on_nack = on_nack
+        transport = self._fresh_transport()
+        transport.dispatch({
+            "type": "connect_document",
+            "document_id": self.document_id,
+            "client_id": client_id,
+            "versions": ["1.2", "1.1", "1.0"],
+        })
+        transport.drain()
+        connected = None
+        rest = []
+        for f in transport.inbox:
+            if f.get("type") in ("connected",
+                                 "connect_document_error") \
+                    and connected is None:
+                connected = f
+            else:
+                rest.append(f)
+        transport.inbox[:] = rest
+        if connected is None or \
+                connected["type"] == "connect_document_error":
+            raise PermissionError(
+                f"connect_document rejected: "
+                f"{(connected or {}).get('message', 'no reply')}")
+        self.connection = ChaosDeltaConnection(self, client_id)
+        self.harness.register_transport(self)
+        return self.connection
+
+    def read_ops(self, from_seq: int,
+                 to_seq=None) -> list[SequencedMessage]:
+        frame = self._request({
+            "type": "read_ops", "document_id": self.document_id,
+            "from_seq": from_seq, "to_seq": to_seq,
+        })
+        return [message_from_json(m) for m in frame["msgs"]]
+
+    def get_latest_summary(self):
+        frame = self._request({
+            "type": "fetch_summary", "document_id": self.document_id,
+        })
+        if frame.get("sequence_number") is None:
+            return None
+        return frame["sequence_number"], decode_contents(
+            frame["summary"])
+
+    _UPLOAD_CHUNK = 2048  # small, so uploads really chunk in tests
+
+    def upload_summary(self, summary: dict) -> str:
+        from ..protocol.serialization import encode_contents
+
+        payload = json.dumps(encode_contents(summary))
+        parts = [payload[i:i + self._UPLOAD_CHUNK]
+                 for i in range(0, len(payload), self._UPLOAD_CHUNK)
+                 ] or [""]
+        upload_id = f"cu{next(self._rids)}"
+        for i, part in enumerate(parts):
+            data = {
+                "type": "upload_summary_chunk",
+                "document_id": self.document_id,
+                "upload_id": upload_id,
+                "chunk": i, "total": len(parts), "data": part,
+            }
+            if i + 1 < len(parts):
+                self.transport.dispatch(data)
+            else:
+                frame = self._request(data)
+        return frame["handle"]
+
+    # -- inbound delivery (driven by the harness pump) ------------------
+
+    def _deliver(self, frame: dict) -> None:
+        kind = frame.get("type")
+        if kind == "op" and self._on_message is not None:
+            self._on_message(message_from_json(frame["msg"]))
+        elif kind == "nack":
+            self._deliver_nack(frame)
+        # "error"/"upload_ack"/stray rid replies: nothing to deliver
+
+    def _deliver_nack(self, frame: dict) -> None:
+        if self._on_nack is None:
+            return
+        from ..service.ingress import document_message_from_json
+
+        op = frame.get("operation")
+        self._on_nack(Nack(
+            operation=document_message_from_json(op) if op else None,
+            sequence_number=frame.get("sequence_number", 0),
+            error_type=NackErrorType(frame["error_type"]),
+            message=frame.get("message", ""),
+            retry_after_seconds=frame.get("retry_after_seconds"),
+        ))
+
+    def close(self) -> None:
+        if self.transport is not None:
+            self.transport.die()
+
+
+# ======================================================================
+# the harness
+
+
+DOC_ALPHA = "chaos-alpha"
+DOC_BETA = "chaos-beta"
+
+
+class ChaosHarness:
+    """Server + sidecar + frame-level clients, rebuildable from disk.
+
+    The sidecar rides the tiny ladder (capacity 16 -> 32, pool 128)
+    so the beta document genuinely overflows into the pool tier
+    mid-run — chaos then fires through grow/pool-admit/pool-dispatch
+    recovery, not just the steady path."""
+
+    SIDECAR_CAPACITY = 16
+    SIDECAR_MAX_CAPACITY = 32
+    SIDECAR_POOL_CAPACITY = 128
+
+    def __init__(self, durable_dir: str, checkpoint_every: int = 5):
+        self.durable_dir = durable_dir
+        self.checkpoint_every = checkpoint_every
+        self.clock = ManualClock()
+        self.services: dict[str, ChaosDocumentService] = {}
+        self._transports: dict[str, ChaosTransport] = {}
+        self.server: Optional[AlfredServer] = None
+        self.sidecar = None
+        self.crashes = 0
+        self._boot()
+
+    def _boot(self) -> None:
+        # the production wiring: checkpoint writes behind a breaker
+        # (a failing disk degrades durability, never availability —
+        # the op log is the recovery path), on the harness clock so
+        # open->half-open->close is step-deterministic
+        self.server = AlfredServer(LocalServer(
+            durable_dir=self.durable_dir,
+            checkpoint_every=self.checkpoint_every,
+            storage_breaker=CircuitBreaker(
+                "chaos-checkpoint", failure_threshold=3,
+                reset_timeout_s=0.2, clock=self.clock,
+            ),
+        ))
+        self._build_sidecar()
+
+    def _build_sidecar(self) -> None:
+        import jax
+
+        from ..parallel import make_seq_mesh
+        from ..service.tpu_sidecar import TpuMergeSidecar
+
+        self.sidecar = TpuMergeSidecar(
+            max_docs=4,
+            capacity=self.SIDECAR_CAPACITY,
+            max_capacity=self.SIDECAR_MAX_CAPACITY,
+            seq_mesh=make_seq_mesh(jax.devices()[:1]),
+            pool_capacity=self.SIDECAR_POOL_CAPACITY,
+            breaker=CircuitBreaker(
+                "chaos-sidecar", failure_threshold=3,
+                reset_timeout_s=0.2, clock=self.clock,
+            ),
+        )
+        self.sidecar.subscribe(
+            self.server.local, DOC_BETA, "app", "text")
+
+    def service_for(self, document_id: str,
+                    client_name: str) -> ChaosDocumentService:
+        svc = ChaosDocumentService(self, document_id, client_name)
+        self.services[client_name] = svc
+        return svc
+
+    def register_transport(self, svc: ChaosDocumentService) -> None:
+        self._transports[svc.client_name] = svc.transport
+
+    # -- delivery pump --------------------------------------------------
+
+    def pump(self) -> int:
+        """Deliver queued fanout frames to every client, firing the
+        ``socket.frame_in`` site per 'op' frame. Deterministic order:
+        clients in registration order; per client, delayed frames
+        from the previous pump first, then fresh drains. Reordered
+        frames deliver after the next delivered frame; delayed ones
+        at the next pump. Returns frames delivered."""
+        delivered = 0
+        for name, svc in list(self.services.items()):
+            transport = svc.transport
+            if transport is None or not transport.open:
+                continue
+            transport.drain()
+            todo = transport.delayed + transport.inbox
+            transport.delayed = []
+            transport.inbox = []
+            held: list[dict] = []
+            i = 0
+            while i < len(todo) or held:
+                if i >= len(todo):
+                    # tail: nothing left to reorder past — flush holds
+                    frame, held = held[0], held[1:]
+                else:
+                    frame = todo[i]
+                    i += 1
+                    if frame.get("type") == "op":
+                        fault = _SITE_IN.fire(client=name)
+                        if fault == KIND_DROP:
+                            continue
+                        if fault == KIND_DUPLICATE:
+                            todo.insert(i, frame)
+                        elif fault == KIND_REORDER:
+                            held.append(frame)
+                            continue
+                        elif fault == KIND_DELAY:
+                            transport.delayed.append(frame)
+                            continue
+                svc._deliver(frame)
+                delivered += 1
+                if held and frame.get("type") == "op":
+                    # a later frame passed the held one: release
+                    todo[i:i] = held
+                    held = []
+                if not transport.open:
+                    # a delivery fault tore the transport down
+                    break
+            # frames drained into inbox by re-entrant requests during
+            # delivery are picked up next pump
+        return delivered
+
+    # -- crash-restart --------------------------------------------------
+
+    def crash(self, tear: Optional[str] = None,
+              containers: Optional[list[Container]] = None) -> bool:
+        """Kill the whole service with no goodbyes and rebuild it from
+        disk. ``tear`` additionally applies one enumerated torn crash
+        state first:
+
+        - ``"checkpoint_tmp"``: crash between the checkpoint's
+          temp-write and rename (torn .tmp beside the intact
+          checkpoint);
+        - ``"checkpoint_final"``: prefix-truncated checkpoint.json —
+          the pre-fsync reordered-write state (read_checkpoint must
+          degrade to op-log fast-forward);
+        - ``"oplog_tail"``: prefix-truncated final op-log line — legal
+          ONLY for an op no client processed (the fsync-before-fanout
+          barrier); asserted against ``containers``, skipped (and
+          recorded) if the barrier would be violated.
+
+        Returns whether the torn state was ACTUALLY applied — callers
+        must not report (or count toward coverage) a tear the barrier
+        refused.
+        """
+        for transport in self._transports.values():
+            transport.abandon()
+        for svc in self.services.values():
+            if svc.connection is not None:
+                svc.connection.open = False
+        self.server = None
+        self.crashes += 1
+        applied = False
+        if tear:
+            applied = self._apply_tear(tear, containers or [])
+        self._boot()
+        # the sidecar rebuilds from the durable op log — the recovery
+        # the differential pins live-state-equal to
+        for msg in self.server.local.read_ops(DOC_BETA, 0):
+            self.sidecar.ingest(DOC_BETA, msg)
+        return applied
+
+    def _apply_tear(self, tear: str,
+                    containers: list[Container]) -> bool:
+        """Apply one torn crash state; returns whether it actually
+        applied (the barrier can refuse — see ``crash``)."""
+        doc_dir = os.path.join(self.durable_dir, DOC_ALPHA)
+        site = PLANE.site("storage.checkpoint_write")
+        if tear == "checkpoint_tmp":
+            path = os.path.join(doc_dir, "checkpoint.json")
+            data = open(path, "rb").read() if os.path.exists(path) \
+                else b'{"torn'
+            with open(path + ".tmp", "wb") as f:
+                f.write(data[:max(1, len(data) // 2)])
+            site.force(KIND_TORN_WRITE, state="checkpoint_tmp")
+            return True
+        if tear == "checkpoint_final":
+            path = os.path.join(doc_dir, "checkpoint.json")
+            if not os.path.exists(path):
+                return False
+            data = open(path, "rb").read()
+            with open(path, "wb") as f:
+                f.write(data[:max(1, len(data) // 2)])
+            site.force(KIND_TORN_WRITE, state="checkpoint_final")
+            return True
+        if tear == "oplog_tail":
+            path = os.path.join(doc_dir, "ops.jsonl")
+            if not os.path.exists(path):
+                return False
+            with open(path, "rb") as f:
+                lines = f.readlines()
+            if not lines:
+                return False
+            last_seq = json.loads(lines[-1])["sequenceNumber"]
+            seen = max((c.last_processed_seq for c in containers
+                        if c.service.document_id == DOC_ALPHA),
+                       default=0)
+            if last_seq <= seen:
+                # the fsync-before-fanout barrier says this op is
+                # durable-by-contract (a client processed it): this
+                # crash state is UNREACHABLE — record the skip
+                PLANE.flight.record("tear-skipped", seq=last_seq,
+                                    seen=seen)
+                return False
+            torn = lines[-1][:max(1, len(lines[-1]) // 2)]
+            with open(path, "wb") as f:
+                f.writelines(lines[:-1])
+                f.write(torn)
+            PLANE.site("storage.oplog_append").force(
+                KIND_TORN_WRITE, state="oplog_tail", seq=last_seq)
+            return True
+        raise ValueError(f"unknown tear state {tear!r}")
+
+
+class ManualClock:
+    """The injectable step clock every deterministic harness shares —
+    ONE owner (tools/stress and tools/serve_bench import it from
+    here; tools may import testing, never the reverse)."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ======================================================================
+# the scripted workload + convergence report
+
+
+@dataclass
+class ChaosReport:
+    seed: int
+    faults_armed: bool = True
+    converged: bool = False
+    failures: list[str] = field(default_factory=list)
+    fired: list[tuple] = field(default_factory=list)
+    chaos_counts: dict = field(default_factory=dict)
+    crashes: int = 0
+    tear: Optional[str] = None
+    #: the planned tear was ACTUALLY applied (the barrier can refuse
+    #: an unreachable state — coverage must not count those)
+    tear_applied: bool = False
+    reconnects: int = 0
+    acked_ops: int = 0
+    alpha_text: str = ""
+    alpha_kv: str = ""
+    beta_text: str = ""
+    sidecar_tier: str = ""
+    pool_watermarks: dict = field(default_factory=dict)
+
+    def deterministic_fields(self) -> dict:
+        """Everything that must be bit-equal for the same seed (the
+        config9 discipline: nothing wall-clock rides here)."""
+        return {
+            "fired": list(self.fired),
+            "chaos_counts": dict(self.chaos_counts),
+            "crashes": self.crashes,
+            "tear": self.tear,
+            "tear_applied": self.tear_applied,
+            "reconnects": self.reconnects,
+            "acked_ops": self.acked_ops,
+            "alpha_text": self.alpha_text,
+            "alpha_kv": self.alpha_kv,
+            "beta_text": self.beta_text,
+            "sidecar_tier": self.sidecar_tier,
+            "pool_watermarks": dict(self.pool_watermarks),
+        }
+
+
+def standard_schedule(seed: int,
+                      sites: Optional[list[str]] = None
+                      ) -> FaultSchedule:
+    return FaultSchedule(seed, rates=standard_rates(sites))
+
+
+def crash_plan(seed: int, n_steps: int) -> tuple[Optional[int],
+                                                 Optional[str]]:
+    """(crash step, tear state) as a PURE function of the seed — odd
+    seeds crash mid-run, cycling through the enumerated tear states —
+    so a failing seed reproduces with no side channel, and any seed
+    range [0, 2k) provably covers every crash/tear combination."""
+    if seed % 2 == 0:
+        return None, None
+    tear = [None, "checkpoint_tmp", "checkpoint_final",
+            "oplog_tail"][(seed // 2) % 4]
+    step = n_steps // 2 + (seed % 5)
+    return step, tear
+
+
+_ALPHA_TAGS = ("A", "B", "C")
+
+
+def _region_edit(container: Container, tag: str, serial: int,
+                 rng: random.Random) -> None:
+    """One conflict-free edit inside the client's own marker-delimited
+    region: append a UNIQUE marker string at the region's end, or
+    remove a couple of the client's own trailing characters. Position
+    arithmetic runs against the client's own view; only this client
+    writes inside its region, so the region's content is a pure fold
+    of its own edit history — interleaving-invariant by construction
+    (the docstring up top explains why the differential needs that)."""
+    text = container.runtime.get_datastore("app").get_channel("text")
+    view = text.get_text()
+    start = view.index(f"[{tag}]") + len(tag) + 2
+    order = _ALPHA_TAGS + ("Z",)
+    ends = [view.index(f"[{t}]") for t in order
+            if t != tag and f"[{t}]" in view and
+            view.index(f"[{t}]") >= start]
+    end = min(ends) if ends else len(view)
+    if rng.random() < 0.25 and end - start > 8:
+        cut = rng.randrange(2, 4)
+        text.remove_text(end - cut, end)
+    else:
+        text.insert_text(end, f"{tag.lower()}{serial:03d}.")
+
+
+def run_chaos(seed: int, faults: bool = True,
+              n_steps: int = 40, workload_seed: int = 1234,
+              durable_dir: Optional[str] = None,
+              sites: Optional[list[str]] = None) -> ChaosReport:
+    """One chaos run: scripted workload, seeded schedule, optional
+    crash-restart, quiesce, convergence checks. ``faults=False`` is
+    the fault-free oracle (same workload, nothing armed, no crash).
+    Everything a failure needs rides the returned report."""
+    report = ChaosReport(seed=seed, faults_armed=faults)
+    before = obs_metrics.REGISTRY.flat()
+    tmp_owned = durable_dir is None
+    if tmp_owned:
+        import tempfile
+
+        durable_dir = tempfile.mkdtemp(prefix="fftpu-chaos-")
+    try:
+        _run_chaos_into(report, seed, faults, n_steps,
+                        workload_seed, durable_dir, sites)
+    finally:
+        if PLANE.armed:
+            PLANE.disarm()
+        if tmp_owned:
+            shutil.rmtree(durable_dir, ignore_errors=True)
+    delta = obs_metrics.REGISTRY.delta(before)
+    report.chaos_counts = {
+        k: int(v) for k, v in sorted(delta.items())
+        if k.startswith("chaos_injected_total")
+    }
+    report.converged = not report.failures
+    return report
+
+
+def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
+                    n_steps: int, workload_seed: int,
+                    durable_dir: str,
+                    sites: Optional[list[str]]) -> None:
+    harness = ChaosHarness(durable_dir)
+    wl = random.Random(workload_seed)  # the SAME script for any seed
+    crash_step, tear = crash_plan(seed, n_steps) if faults \
+        else (None, None)
+    report.tear = tear if crash_step is not None else None
+
+    # --- setup (pre-arm): regions + channels, everyone synced --------
+    writers: list[Container] = []
+    for i, tag in enumerate(_ALPHA_TAGS):
+        svc = harness.service_for(DOC_ALPHA, f"alpha-{tag}")
+        writers.append(Container.load(svc, client_id=f"client-{tag}"))
+    ds = writers[0].runtime.create_datastore("app")
+    ds.create_channel("sharedstring", "text")
+    ds.create_channel("sharedmap", "kv")
+    text0 = writers[0].runtime.get_datastore("app").get_channel("text")
+    text0.insert_text(0, "[A][B][C][Z]")
+    writers[0].flush()
+    harness.pump()
+    beta_svc = harness.service_for(DOC_BETA, "beta-W")
+    beta = Container.load(beta_svc, client_id="client-W")
+    bds = beta.runtime.create_datastore("app")
+    bds.create_channel("sharedstring", "text")
+    beta.flush()
+    harness.pump()
+
+    serials = [0, 0, 0]
+    beta_serial = 0
+    down_until: dict[int, int] = {}
+    all_containers = writers + [beta]
+
+    # acked = own OPERATION msgs seen back sequenced, off the
+    # 'processed' event (monotone across reconnect epochs)
+    acked_box = [0]
+
+    def _count_ack(c: Container):
+        from ..protocol.messages import MessageType as _MT
+
+        def on_processed(msg) -> None:
+            if msg.type == _MT.OPERATION \
+                    and msg.client_id == c.client_id:
+                acked_box[0] += 1
+        return on_processed
+
+    for c in all_containers:
+        c.on("processed", _count_ack(c))
+
+    schedule = standard_schedule(seed, sites)
+    reconnect_rng = schedule.rng_for("reconnect")
+    if faults:
+        PLANE.arm(schedule)
+
+    def beta_edit() -> None:
+        nonlocal beta_serial
+        btext = beta.runtime.get_datastore("app").get_channel("text")
+        length = btext.get_length()
+        if wl.random() < 0.2 and length > 12:
+            start = wl.randrange(0, length - 3)
+            btext.remove_text(start, start + 2)
+        else:
+            pos = wl.randrange(0, length + 1)
+            beta_serial += 1
+            btext.insert_text(pos, f"w{beta_serial:03d}.")
+
+    # --- the scripted main loop --------------------------------------
+    for step in range(n_steps):
+        harness.clock.t += 0.05
+        # reconnects due this step (transport deaths + crash)
+        for i, when in list(down_until.items()):
+            c = all_containers[i]
+            if step >= when:
+                del down_until[i]
+                if not c.connected and not c.closed:
+                    c.connect()
+                    report.reconnects += 1
+        # one scripted action per alpha writer; beta edits 2x (it has
+        # to outgrow the sidecar ladder into the pool tier). Every
+        # client ALWAYS performs its scripted action — offline edits
+        # land in pending local state and resubmit on reconnect (the
+        # stress idiom) — so the edit script (and the workload rng's
+        # consumption) is identical whatever the fault state, which
+        # is what makes the fault-free oracle comparable bit-for-bit.
+        for i, c in enumerate(writers):
+            act = wl.random()
+            if act < 0.55:
+                serials[i] += 1
+                _region_edit(c, _ALPHA_TAGS[i], serials[i], wl)
+            elif act < 0.75:
+                kv = c.runtime.get_datastore("app").get_channel("kv")
+                kv.set(f"{_ALPHA_TAGS[i]}{wl.randrange(8)}",
+                       wl.randrange(1000))
+            # else: think (flush below still runs)
+            _safe_flush(c, all_containers, down_until, i, step,
+                        reconnect_rng)
+        beta_edit()
+        beta_edit()
+        _safe_flush(beta, all_containers, down_until, 3, step,
+                    reconnect_rng)
+        if step == crash_step:
+            # crash AFTER this step's flushes and BEFORE their pump:
+            # the just-sequenced ops' fanout frames die undelivered
+            # with the server, so no client has processed the log
+            # tail — exactly the window where the torn-tail crash
+            # state is reachable under the fsync-before-fanout
+            # barrier (a crash at the pumped boundary would make
+            # every oplog_tail tear a vacuous skip)
+            report.tear_applied = harness.crash(
+                tear=tear, containers=all_containers)
+            for i in range(len(all_containers)):
+                down_until[i] = step + 1 + reconnect_rng.randrange(3)
+        harness.pump()
+        # summarize alpha occasionally (through the chunked upload
+        # plane — its chaos site degrades it to the inline path).
+        # Gated on EVERY alpha replica being connected and aligned:
+        # the summary ack truncates the op log at the proposal's
+        # refSeq, and a replica still below that point would be
+        # stranded (reconnect cannot catch up from a truncated log —
+        # the loud Container.connect error this harness surfaced)
+        if step in (n_steps // 3, (2 * n_steps) // 3):
+            c = writers[0]
+            aligned = (
+                all(_alive(w) for w in writers)
+                and len({w.last_processed_seq for w in writers}) == 1
+                and c.runtime.pending.count == 0
+                and not c._sent_times
+            )
+            if aligned:
+                try:
+                    c.summarize()
+                except (RuntimeError, ConnectionError):
+                    pass  # transient: the next summary window retries
+                harness.pump()
+        # sidecar dispatch round every 3rd step
+        if step % 3 == 2:
+            try:
+                harness.sidecar.apply()
+            except TransientFault:
+                pass  # queued ops retry at the next round
+    # --- quiesce: disarm, reconnect, drain to a fixed point ----------
+    if faults:
+        PLANE.disarm()
+    def unsettled(c: Container) -> bool:
+        # pending local state, in-flight ops, or a replica stale
+        # behind the service head (a chaos-dropped fanout frame with
+        # no follow-on traffic never redelivers by itself: gap
+        # detection needs a NEXT frame to notice)
+        head = harness.server.local.get_orderer(
+            c.service.document_id).op_log.last_seq
+        return bool(c.runtime.pending.count or c._sent_times
+                    or c.last_processed_seq < head)
+
+    for _round in range(12):
+        harness.clock.t += 0.3  # lets the sidecar breaker half-open
+        for c in all_containers:
+            if not c.connected and not c.closed:
+                c.connect()
+                report.reconnects += 1
+            c.flush()
+        harness.pump()
+        harness.sidecar.apply()
+        if not any(unsettled(c) for c in all_containers):
+            break
+        if _round >= 2:
+            # still unsettled: heal exactly the way a real client
+            # would — drop the connection and reconnect. Catch-up
+            # replays everything missed from the op log (dropped
+            # acks AND dropped remote fanout) and the pending replay
+            # resubmits the rest.
+            for c in all_containers:
+                if not c.closed and unsettled(c):
+                    c.disconnect()
+                    c.connect()
+                    report.reconnects += 1
+                    c.flush()
+            harness.pump()
+    else:
+        stuck = [c.client_id for c in all_containers if unsettled(c)]
+        if stuck:
+            report.failures.append(
+                f"quiesce never drained pending state for {stuck}")
+    harness.sidecar.sync()
+    _check_convergence(report, harness, writers, beta)
+    report.crashes = harness.crashes
+    report.acked_ops = acked_box[0]
+    # PLANE.fired is reset by arm(): an unarmed (oracle) run must
+    # report [] — not whatever sequence a PREVIOUS armed run left
+    # behind in the process-wide plane
+    report.fired = list(PLANE.fired) if faults else []
+    for c in all_containers:
+        c.close()
+
+
+def _alive(c: Container) -> bool:
+    return c.connected
+
+
+def _note_down(containers, down_until: dict, i: int, step: int,
+               rng: random.Random) -> None:
+    """A client whose transport died schedules its reconnect 1-3
+    steps out (the jittered-backoff shape, on the step clock)."""
+    if i not in down_until and not containers[i].connected:
+        down_until[i] = step + 1 + rng.randrange(3)
+
+
+def _safe_flush(c: Container, containers, down_until, i, step,
+                rng) -> None:
+    c.flush()
+    if not c.connected:
+        _note_down(containers, down_until, i, step, rng)
+
+
+def _check_convergence(report: ChaosReport, harness: ChaosHarness,
+                       writers: list[Container],
+                       beta: Container) -> None:
+    fail = report.failures.append
+
+    def chan(c: Container, name: str):
+        return c.runtime.get_datastore("app").get_channel(name)
+
+    # 1. replica agreement on alpha (text, signature, kv)
+    texts = [chan(c, "text").get_text() for c in writers]
+    sigs = [repr(chan(c, "text").signature()) for c in writers]
+    kvs = [repr(sorted(chan(c, "kv").items())) for c in writers]
+    if len(set(texts)) != 1 or len(set(sigs)) != 1:
+        fail(f"alpha text/signature divergence: {texts} {sigs}")
+    if len(set(kvs)) != 1:
+        fail(f"alpha kv divergence: {kvs}")
+    report.alpha_text = texts[0]
+    report.alpha_kv = kvs[0]
+    report.beta_text = chan(beta, "text").get_text()
+
+    # 2. late joiner: a FRESH replica loaded from the service (summary
+    # + trailing ops) must agree — the full storage-plane round trip
+    late = Container.load(
+        harness.service_for(DOC_ALPHA, "alpha-late"),
+        client_id="client-late")
+    if chan(late, "text").get_text() != texts[0]:
+        fail("late-joining replica diverged from live replicas")
+    if repr(sorted(chan(late, "kv").items())) != kvs[0]:
+        fail("late-joining replica kv diverged")
+    late.close()
+
+    # 3. exactly-once edits: every serial marker present in the
+    # converged text appears exactly once (a double-applied op would
+    # repeat one), and the quiesce loop above already drove every
+    # submitted marker to acked (nothing pending/in-flight) — so a
+    # LOST acked op surfaces as the oracle-equality failure in the
+    # test layer, and a duplicated one fails right here
+    import re
+
+    for haystack in (texts[0], report.beta_text):
+        for marker in re.findall(r"[abcw]\d{3}\.", haystack):
+            if haystack.count(marker) != 1:
+                fail(f"marker {marker!r} applied "
+                     f"{haystack.count(marker)} times")
+
+    # 4. the sidecar's served state: text equals the single-writer
+    # replica's, and a SHADOW sidecar rebuilt from the durable op log
+    # must serve the identical text+signature (live ≡ rebuilt — the
+    # crash-recovery equivalence, checked on every run)
+    side_text = harness.sidecar.text(DOC_BETA, "app", "text")
+    if side_text != report.beta_text:
+        fail(f"sidecar text diverged from the beta replica: "
+             f"{side_text!r} != {report.beta_text!r}")
+    shadow = _shadow_sidecar(harness)
+    shadow_text = shadow.text(DOC_BETA, "app", "text")
+    shadow_sig = shadow.signature(DOC_BETA, "app", "text")
+    live_sig = harness.sidecar.signature(DOC_BETA, "app", "text")
+    if shadow_text != side_text or shadow_sig != live_sig:
+        fail("rebuilt-from-op-log sidecar diverged from the live one")
+
+    # 5. exactly-once pool watermarks: every pooled member's watermark
+    # sits exactly at its stream head (nothing pending, nothing
+    # double-counted)
+    sc = harness.sidecar
+    report.sidecar_tier = (
+        "host" if sc.host_mode_docs() else
+        "pool" if sc.pooled_docs() else "primary")
+    if sc._pool is not None:
+        for slot, upto in sc._pool.applied_upto.items():
+            want = len(sc._streams[slot].ops)
+            report.pool_watermarks[str(slot)] = upto
+            if upto != want:
+                fail(f"pool watermark slot {slot}: {upto} != {want}")
+
+
+def _shadow_sidecar(harness: ChaosHarness):
+    """A fresh sidecar fed the durable op log from scratch — what a
+    crash-restart would serve."""
+    import jax
+
+    from ..parallel import make_seq_mesh
+    from ..service.tpu_sidecar import TpuMergeSidecar
+
+    shadow = TpuMergeSidecar(
+        max_docs=4,
+        capacity=ChaosHarness.SIDECAR_CAPACITY,
+        max_capacity=ChaosHarness.SIDECAR_MAX_CAPACITY,
+        seq_mesh=make_seq_mesh(jax.devices()[:1]),
+        pool_capacity=ChaosHarness.SIDECAR_POOL_CAPACITY,
+    )
+    shadow.track(DOC_BETA, "app", "text")
+    for msg in harness.server.local.read_ops(DOC_BETA, 0):
+        shadow.ingest(DOC_BETA, msg)
+    shadow.apply()
+    shadow.sync()
+    return shadow
+
+
+# ======================================================================
+# chaos storm (tools/stress --chaos, bench config11): goodput dip +
+# recovery time on the step clock
+
+
+@dataclass
+class ChaosStormReport:
+    seed: int
+    steps: int = 0
+    storm_steps: tuple = ()
+    offered_ops: int = 0
+    acked_ops: int = 0
+    goodput_steady: float = 1.0
+    goodput_dip: float = 1.0        # worst rolling acked/offered
+    recovery_steps: Optional[int] = None
+    recovery_time_s: Optional[float] = None
+    converged: bool = False
+    failures: list = field(default_factory=list)
+    chaos_counts: dict = field(default_factory=dict)
+    fired: int = 0
+    metrics_delta: dict = field(default_factory=dict)
+
+    def deterministic_fields(self) -> dict:
+        return {
+            "offered_ops": self.offered_ops,
+            "acked_ops": self.acked_ops,
+            "goodput_dip": round(self.goodput_dip, 6),
+            "recovery_steps": self.recovery_steps,
+            "fired": self.fired,
+            "converged": self.converged,
+        }
+
+
+def run_chaos_storm(seed: int = 0, steps: int = 120,
+                    storm: tuple[int, int] = (40, 80),
+                    window: int = 8, slo_target: float = 0.95,
+                    sites: Optional[list[str]] = None
+                    ) -> ChaosStormReport:
+    """Three phases on one step clock: steady (faults off), STORM
+    (the standard schedule armed), recovery (faults off again).
+    Goodput = rolling acked/offered over ``window`` steps; the dip is
+    its minimum from storm start on, and recovery time is how many
+    steps past storm end it takes to hold the ``slo_target`` floor
+    again for ``window`` consecutive steps. Deterministic per seed on
+    the step clock (wall time never enters the numbers)."""
+    import re
+    import tempfile
+
+    report = ChaosStormReport(seed=seed, steps=steps,
+                              storm_steps=storm)
+    before = obs_metrics.REGISTRY.flat()
+    durable = tempfile.mkdtemp(prefix="fftpu-chaos-storm-")
+    harness = ChaosHarness(durable)
+    wl = random.Random(4242)
+    schedule = standard_schedule(seed, sites)
+    reconnect_rng = schedule.rng_for("reconnect")
+    try:
+        writers: list[Container] = []
+        for i, tag in enumerate(_ALPHA_TAGS):
+            svc = harness.service_for(DOC_ALPHA, f"alpha-{tag}")
+            writers.append(
+                Container.load(svc, client_id=f"client-{tag}"))
+        ds = writers[0].runtime.create_datastore("app")
+        ds.create_channel("sharedstring", "text")
+        ds.create_channel("sharedmap", "kv")
+        writers[0].runtime.get_datastore("app").get_channel(
+            "text").insert_text(0, "[A][B][C][Z]")
+        writers[0].flush()
+        harness.pump()
+
+        serials = [0, 0, 0]
+        down_until: dict[int, int] = {}
+        # acked = own OPERATION msgs seen sequenced, counted off the
+        # 'processed' event: monotone across reconnect epochs (csn
+        # resets per connection, so csn arithmetic can't be)
+        acked_total = [0, 0, 0]
+        acked_prev = 0
+
+        def _count_acks(idx: int):
+            from ..protocol.messages import MessageType as _MT
+
+            def on_processed(msg) -> None:
+                if (msg.type == _MT.OPERATION
+                        and msg.client_id == writers[idx].client_id):
+                    acked_total[idx] += 1
+            return on_processed
+
+        for i in range(len(writers)):
+            writers[i].on("processed", _count_acks(i))
+        rolling: list[tuple[int, int]] = []
+        post_storm_ok = 0
+        storm_lo, storm_hi = storm
+        for step in range(steps):
+            harness.clock.t += 0.05
+            if step == storm_lo:
+                PLANE.arm(schedule)
+            if step == storm_hi:
+                PLANE.disarm()
+            for i, when in list(down_until.items()):
+                if step >= when:
+                    del down_until[i]
+                    c = writers[i]
+                    if not c.connected and not c.closed:
+                        c.connect()
+            offered = 0
+            acked = 0
+            for i, c in enumerate(writers):
+                if i in down_until or not c.connected:
+                    _note_down(writers, down_until, i, step,
+                               reconnect_rng)
+                    continue
+                serials[i] += 1
+                offered += 1
+                _region_edit(c, _ALPHA_TAGS[i], serials[i], wl)
+                _safe_flush(c, writers, down_until, i, step,
+                            reconnect_rng)
+            harness.pump()
+            acked = sum(acked_total) - acked_prev
+            acked_prev = sum(acked_total)
+            report.offered_ops += offered
+            report.acked_ops += acked
+            rolling.append((offered, acked))
+            if len(rolling) > window:
+                rolling.pop(0)
+            off = sum(o for o, _ in rolling)
+            ack = sum(a for _, a in rolling)
+            ratio = (ack / off) if off else 1.0
+            if step < storm_lo:
+                report.goodput_steady = min(report.goodput_steady,
+                                            ratio)
+            else:
+                report.goodput_dip = min(report.goodput_dip, ratio)
+            if step >= storm_hi and report.recovery_steps is None:
+                if ratio >= slo_target:
+                    post_storm_ok += 1
+                    if post_storm_ok >= window:
+                        report.recovery_steps = (
+                            step - storm_hi - window + 1)
+                        report.recovery_time_s = (
+                            report.recovery_steps * 0.05)
+                else:
+                    post_storm_ok = 0
+        # quiesce + convergence (agreement only: the storm harness has
+        # no oracle run — the differential in tests/test_chaos.py is
+        # where oracle equality lives)
+        if PLANE.armed:
+            PLANE.disarm()
+        for _ in range(10):
+            for c in writers:
+                if not c.connected and not c.closed:
+                    c.connect()
+                c.flush()
+            harness.pump()
+            if all(c.runtime.pending.count == 0 and not c._sent_times
+                   for c in writers):
+                break
+        texts = [c.runtime.get_datastore("app").get_channel(
+            "text").get_text() for c in writers]
+        if len(set(texts)) != 1:
+            report.failures.append(f"storm divergence: {texts}")
+        else:
+            final = texts[0]
+            for marker in re.findall(r"[abc]\d{3}\.", final):
+                if final.count(marker) != 1:
+                    report.failures.append(
+                        f"marker {marker!r} x{final.count(marker)}")
+        report.converged = not report.failures
+        # arm() reset PLANE.fired at storm start, so the count is
+        # this storm's own; a run whose window never armed reports 0
+        report.fired = len(PLANE.fired) if steps > storm_lo else 0
+        for c in writers:
+            c.close()
+    finally:
+        if PLANE.armed:
+            PLANE.disarm()
+        shutil.rmtree(durable, ignore_errors=True)
+    delta = obs_metrics.REGISTRY.delta(before)
+    report.chaos_counts = {
+        k: int(v) for k, v in sorted(delta.items())
+        if k.startswith("chaos_injected_total")
+    }
+    report.metrics_delta = delta
+    return report

@@ -1,10 +1,19 @@
-"""Fault-injection driver wrappers.
+"""Fault-injection driver wrappers — site-backed since the chaos PR.
 
 Reference: packages/test/test-service-load/src/faultInjectionDriver.ts
 (:27,:62,:135,:241,:254) — wrappers over IDocumentService /
 IDocumentDeltaConnection that inject disconnects and error nacks on
 demand or on a schedule, so failure paths (reconnect, resubmit,
 rebase) get exercised under load.
+
+These wrappers now speak the ONE injection vocabulary of the chaos
+plane (qos/faults.py): ``inject_nacks``/``inject_disconnect`` queue
+scripted faults on the same named sites a seeded ``FaultSchedule``
+fires at (``socket.frame_out``), and the ScriptedFrameServer's
+CORRUPT reply records through ``testing.scripted_frame`` — so every
+injection, scripted or scheduled, shows up in
+``chaos_injected_total{site,kind}`` and the plane's flight recorder.
+The public API is unchanged (the PR1/PR4 suites drive it as before).
 """
 from __future__ import annotations
 
@@ -16,6 +25,19 @@ from ..protocol.messages import (
     NackErrorType,
     SequencedMessage,
 )
+from ..qos.faults import (
+    KIND_CORRUPT,
+    KIND_DISCONNECT,
+    KIND_NACK,
+    PLANE as _CHAOS,
+)
+
+# scripted injections ride the SAME site the schedule-driven socket
+# faults use; the frame server's protocol corruption gets its own
+# (it is a peer misbehaving, not this process's transport)
+_SITE_FRAME_OUT = _CHAOS.site(
+    "socket.frame_out", (KIND_DISCONNECT, KIND_NACK))
+_SITE_SCRIPTED = _CHAOS.site("testing.scripted_frame", (KIND_CORRUPT,))
 
 
 class FaultInjectionConnection:
@@ -40,6 +62,11 @@ class FaultInjectionConnection:
         self.submits += 1
         if self.injected_nack_next > 0:
             self.injected_nack_next -= 1
+            # recorded on the shared transport site (force, not push:
+            # WHICH connection nacks is this wrapper's own state — a
+            # site-level queue could be stolen by an unrelated socket
+            # driver consulting the same seam)
+            _SITE_FRAME_OUT.force(KIND_NACK, scripted=True)
             if self._on_nack is not None:
                 self._on_nack(Nack(
                     operation=op,
@@ -58,6 +85,7 @@ class FaultInjectionConnection:
 
     def inject_disconnect(self) -> None:
         """Hard-drop the socket without telling the client object."""
+        _SITE_FRAME_OUT.force(KIND_DISCONNECT, scripted=True)
         self._inner.disconnect()
 
     def inject_nacks(self, count: int = 1) -> None:
@@ -169,6 +197,8 @@ class ScriptedFrameServer:
                         break
                     reply = self.script.pop(0)
                     if reply is self.CORRUPT:
+                        _SITE_SCRIPTED.force(KIND_CORRUPT,
+                                             scripted=True)
                         conn.sendall(struct.pack(">I", 1 << 31))
                     else:
                         conn.sendall(pack_frame(reply))

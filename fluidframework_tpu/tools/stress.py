@@ -35,6 +35,7 @@ from ..qos import (
 )
 from ..service.ingress import AlfredServer, _ClientSession
 from ..service.local_server import LocalServer
+from ..testing.chaos import ManualClock as _ManualClock
 from ..testing.fault_injection import FaultInjectionDocumentService
 
 
@@ -235,12 +236,9 @@ class OverloadReport:
         return True  # run_overload raises otherwise
 
 
-class _ManualClock:
-    def __init__(self) -> None:
-        self.t = 0.0
-
-    def __call__(self) -> float:
-        return self.t
+# (the manual clock both overload modes inject lives with the chaos
+# harness now — ONE owner; see the import block up top. serve_bench
+# keeps importing `_ManualClock` from here.)
 
 
 class _ScriptedWriter:
@@ -424,7 +422,48 @@ def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
     parser.add_argument("--no-throttle", action="store_true",
                         help="with --overload: run the unprotected "
                              "baseline (no admission control)")
+    parser.add_argument("--chaos", type=int, default=None,
+                        metavar="SEED",
+                        help="run the seeded chaos storm "
+                             "(testing/chaos.py): steady -> fault "
+                             "storm at every registered seam -> "
+                             "recovery; reports goodput dip, "
+                             "recovery time and chaos_injected "
+                             "counts, deterministic per seed")
+    parser.add_argument("--sites", default=None,
+                        help="with --chaos: comma-separated site "
+                             "subset (e.g. socket.frame_in,"
+                             "sidecar.dispatch)")
+    parser.add_argument("--chaos-steps", type=int, default=120)
+    parser.add_argument("--chaos-storm", type=int, nargs=2,
+                        default=(40, 80), metavar=("LO", "HI"),
+                        help="storm window [LO, HI) in steps")
     args = parser.parse_args(argv)
+    if args.chaos is not None:
+        from ..testing.chaos import run_chaos_storm
+
+        report = run_chaos_storm(
+            seed=args.chaos, steps=args.chaos_steps,
+            storm=tuple(args.chaos_storm),
+            sites=args.sites.split(",") if args.sites else None,
+        )
+        print(json.dumps({
+            "seed": report.seed,
+            "steps": report.steps,
+            "storm_steps": list(report.storm_steps),
+            "offered_ops": report.offered_ops,
+            "acked_ops": report.acked_ops,
+            "goodput_steady": round(report.goodput_steady, 4),
+            "goodput_dip": round(report.goodput_dip, 4),
+            "recovery_steps": report.recovery_steps,
+            "recovery_time_s": report.recovery_time_s,
+            "converged": report.converged,
+            "failures": report.failures,
+            "fired": report.fired,
+            "chaos_counts": report.chaos_counts,
+            "metrics_delta": report.metrics_delta,
+        }))
+        return 0 if report.converged else 1
     if args.overload is not None:
         report = run_overload(OverloadConfig(
             offered_multiple=args.overload,

@@ -1,0 +1,581 @@
+"""fluidchaos: the fault plane + the crash-recovery convergence
+differential (docs/ROBUSTNESS.md).
+
+THE differential: 20 seeded fault schedules drive the scripted
+multi-client workload through the real AlfredServer dispatch path
+with faults firing at every registered seam — including full service
+crash-restart mid-run and the enumerated torn-write crash states —
+and every run must end BIT-IDENTICAL to the fault-free oracle:
+replica text/signature/map, the late-joining replica, the sidecar's
+served text, a rebuilt-from-op-log shadow sidecar, exactly-once pool
+watermarks, every marker exactly once. A failing seed reproduces
+from the seed alone: ``run_chaos(seed)``.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from fluidframework_tpu.obs import metrics as obs_metrics
+from fluidframework_tpu.qos.faults import (
+    BURST_LENGTH,
+    KIND_DROP,
+    KIND_DUPLICATE,
+    KIND_ERROR,
+    KIND_ERROR_BURST,
+    KIND_NACK,
+    PLANE,
+    FaultSchedule,
+    standard_rates,
+)
+from fluidframework_tpu.testing.chaos import (
+    ChaosHarness,
+    crash_plan,
+    run_chaos,
+    run_chaos_storm,
+    standard_schedule,
+)
+
+N_SEEDS = 20
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    """The fault-free oracle: the same scripted workload with nothing
+    armed and no crash. One run serves every seed — the workload
+    script is seed-independent by construction."""
+    report = run_chaos(0, faults=False)
+    assert report.converged, report.failures
+    assert report.sidecar_tier == "pool", (
+        "the oracle workload must push the sidecar doc into the pool "
+        "tier, or the differential never exercises pool recovery"
+    )
+    return report
+
+
+# ----------------------------------------------------------------------
+# the convergence differential
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_chaos_convergence_differential(seed, oracle):
+    report = run_chaos(seed)
+    detail = (
+        f"seed {seed} (reproduce: run_chaos({seed})), "
+        f"{len(report.fired)} faults fired, crashes={report.crashes}, "
+        f"tear={report.tear}: {report.failures}"
+    )
+    assert report.converged, detail
+    assert len(report.fired) > 0, f"seed {seed}: no faults fired"
+    if report.tear is not None:
+        # coverage must be REAL: a tear the barrier refused (e.g. a
+        # log tail some client already processed) is a vacuous pass
+        assert report.tear_applied, (
+            f"seed {seed}: planned tear {report.tear!r} was never "
+            "applied — the crash point no longer leaves a tearable "
+            "state")
+    # bit-identical to the fault-free oracle
+    assert report.alpha_text == oracle.alpha_text, detail
+    assert report.alpha_kv == oracle.alpha_kv, detail
+    assert report.beta_text == oracle.beta_text, detail
+
+
+def test_seed_range_covers_crash_and_torn_states():
+    """The acceptance floor: among the N seeds, at least one full
+    crash-restart and at least one of EVERY torn crash state — pinned
+    structurally (crash_plan is a pure function of the seed)."""
+    plans = [crash_plan(seed, 40) for seed in range(N_SEEDS)]
+    crashes = [p for p in plans if p[0] is not None]
+    tears = {p[1] for p in crashes}
+    assert len(crashes) >= 1
+    assert {"checkpoint_tmp", "checkpoint_final",
+            "oplog_tail"} <= tears
+
+
+def test_chaos_runs_are_deterministic():
+    """Same seed => same injection sequence, same convergence report
+    (the config9 discipline: everything compared here rides the step
+    clock and the seeded streams, never the wall clock)."""
+    a = run_chaos(5)
+    b = run_chaos(5)
+    assert a.fired == b.fired
+    assert a.deterministic_fields() == b.deterministic_fields()
+
+
+# ----------------------------------------------------------------------
+# the fault plane itself
+
+
+def test_sites_registered_at_every_seam():
+    # importing the seams registered their sites (module import time)
+    import fluidframework_tpu.drivers.socket_driver  # noqa: F401
+    import fluidframework_tpu.service.partitioning  # noqa: F401
+    import fluidframework_tpu.service.storage  # noqa: F401
+    import fluidframework_tpu.service.tpu_sidecar  # noqa: F401
+
+    names = set(PLANE.sites())
+    assert {
+        "socket.frame_in", "socket.frame_out",
+        "broker.queue_append", "broker.queue_consume",
+        "storage.checkpoint_write", "storage.oplog_append",
+        "sidecar.dispatch", "sidecar.pool_dispatch",
+        "sidecar.pool_admit", "sidecar.pool_migrate",
+        "ingress.summary_upload",
+    } <= names
+
+
+def test_disarmed_site_fires_nothing():
+    site = PLANE.site("test.disarmed", (KIND_DROP,))
+    assert PLANE.schedule is None
+    for _ in range(100):
+        assert site.fire() is None
+
+
+def test_armed_site_fires_deterministically_and_counts():
+    site = PLANE.site("test.deterministic", (KIND_DROP, KIND_NACK))
+    schedule = FaultSchedule(
+        7, rates={"test.deterministic": {KIND_DROP: 0.3,
+                                         KIND_NACK: 0.2}})
+    before = obs_metrics.REGISTRY.flat()
+    with PLANE.while_armed(schedule):
+        first = [site.fire() for _ in range(50)]
+    with PLANE.while_armed(schedule):
+        second = [site.fire() for _ in range(50)]
+    assert first == second, "same seed must fire identically"
+    fired = [f for f in first if f is not None]
+    assert fired, "rates this high must fire within 50 events"
+    delta = obs_metrics.REGISTRY.delta(before)
+    drops = sum(
+        int(v) for k, v in delta.items()
+        if k.startswith("chaos_injected_total")
+        and 'site="test.deterministic"' in k and 'kind="drop"' in k)
+    assert drops == 2 * first.count(KIND_DROP) > 0
+
+
+def test_per_site_streams_are_independent():
+    """Consuming events at one site must not shift another site's
+    decisions — the property that makes multi-seam runs replayable."""
+    a = PLANE.site("test.indep_a", (KIND_DROP,))
+    b = PLANE.site("test.indep_b", (KIND_DROP,))
+    rates = {"test.indep_a": {KIND_DROP: 0.5},
+             "test.indep_b": {KIND_DROP: 0.5}}
+    with PLANE.while_armed(FaultSchedule(3, rates=rates)):
+        b_alone = [b.fire() for _ in range(30)]
+    with PLANE.while_armed(FaultSchedule(3, rates=rates)):
+        for _ in range(17):
+            a.fire()  # interleave traffic at the OTHER site
+        b_mixed = [b.fire() for _ in range(30)]
+    assert b_alone == b_mixed
+
+
+def test_error_burst_poisons_consecutive_events():
+    site = PLANE.site("test.burst", (KIND_ERROR, KIND_ERROR_BURST))
+    schedule = FaultSchedule(
+        1, rates={"test.burst": {KIND_ERROR_BURST: 1.0}})
+    with PLANE.while_armed(schedule):
+        kinds = [site.fire() for _ in range(BURST_LENGTH + 1)]
+    assert kinds[0] == KIND_ERROR_BURST
+    # the burst's tail arrives as plain errors, BURST_LENGTH total
+    assert kinds[1:BURST_LENGTH] == [KIND_ERROR] * (BURST_LENGTH - 1)
+
+
+def test_scripted_push_fires_next_event_and_rejects_unknown_kind():
+    site = PLANE.site("test.scripted", (KIND_NACK,))
+    site.push(KIND_NACK, 2)
+    assert site.fire() == KIND_NACK
+    assert site.fire() == KIND_NACK
+    assert site.fire() is None
+    with pytest.raises(ValueError):
+        site.push(KIND_DROP)
+
+
+def test_standard_rates_site_filter_and_typo():
+    subset = standard_rates(["socket.frame_in"])
+    assert list(subset) == ["socket.frame_in"]
+    with pytest.raises(ValueError):
+        standard_rates(["socket.frame_inn"])
+
+
+def test_fired_log_carries_site_event_kind():
+    site = PLANE.site("test.firedlog", (KIND_DROP,))
+    with PLANE.while_armed(FaultSchedule(
+            0, rates={"test.firedlog": {KIND_DROP: 1.0}})):
+        site.fire()
+        assert PLANE.fired == [("test.firedlog", 1, KIND_DROP)]
+
+
+def test_max_per_site_bounds_injections():
+    site = PLANE.site("test.capped", (KIND_DROP,))
+    schedule = FaultSchedule(
+        0, rates={"test.capped": {KIND_DROP: 1.0}}, max_per_site=3)
+    with PLANE.while_armed(schedule):
+        fired = [site.fire() for _ in range(10)]
+    assert fired.count(KIND_DROP) == 3
+
+
+# ----------------------------------------------------------------------
+# duplicate-delivery idempotence (satellite): every consumer's
+# sequence-number check drops a chaos-duplicated sequenced frame
+
+
+def _mini_sidecar(route: str):
+    import jax
+
+    from fluidframework_tpu.parallel import make_seq_mesh
+    from fluidframework_tpu.parallel.mesh import make_mesh
+    from fluidframework_tpu.service.tpu_sidecar import TpuMergeSidecar
+
+    if route == "seq":
+        mesh = make_seq_mesh(jax.devices()[:1])
+    else:
+        mesh = make_mesh(jax.devices()[:2])
+    return TpuMergeSidecar(
+        max_docs=2, capacity=16, max_capacity=16, seq_mesh=mesh,
+        pool_capacity=128, pool_route=route)
+
+
+@pytest.mark.parametrize("route", ["seq", "mesh"])
+def test_sidecar_ingest_drops_duplicate_sequenced_frames(route):
+    """A duplicated sequenced frame must be dropped by the sidecar's
+    per-document seq check BEFORE it reaches the canonical stream —
+    otherwise the pool watermark would faithfully apply the op twice.
+    Pinned on both pool tiers: the doc overflows into the pool and
+    the duplicated tail op must not change the served text."""
+    from fluidframework_tpu.service.local_server import LocalServer
+
+    server = LocalServer()
+    sidecar = _mini_sidecar(route)
+    sidecar.subscribe(server, "dup-doc", "app", "text")
+    conn = server.connect("dup-doc", "w",
+                          on_message=lambda m: None)
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+    from fluidframework_tpu.models.mergetree.ops import InsertOp
+
+    def insert(i: int, pos: int):
+        conn.submit(DocumentMessage(
+            client_sequence_number=i,
+            reference_sequence_number=0,
+            type=MessageType.OPERATION,
+            contents={
+                "kind": "op", "address": "app", "channel": "text",
+                "contents": InsertOp(pos1=pos, text=f"x{i:02d}."),
+            },
+        ))
+
+    for i in range(1, 25):  # overflows capacity 16 -> pool tier
+        insert(i, (i - 1) * 4)
+    sidecar.apply()
+    sidecar.sync()
+    assert sidecar.pooled_docs() == 1, "doc must reach the pool tier"
+    text_before = sidecar.text("dup-doc", "app", "text")
+    stream_len = len(sidecar._streams[0].ops)
+
+    # replay the tail op AT the sidecar (a chaos-duplicated frame /
+    # an at-least-once redelivery)
+    orderer = server.get_orderer("dup-doc")
+    tail = orderer.op_log.read(0)[-1]
+    dups_before = obs_metrics.REGISTRY.flat().get(
+        "sidecar_duplicate_drops_total", 0)
+    sidecar.ingest("dup-doc", tail)
+    assert len(sidecar._streams[0].ops) == stream_len, (
+        "duplicate extended the canonical stream")
+    sidecar.apply()
+    sidecar.sync()
+    assert sidecar.text("dup-doc", "app", "text") == text_before
+    assert obs_metrics.REGISTRY.flat().get(
+        "sidecar_duplicate_drops_total", 0) == dups_before + 1
+    # exactly-once watermark: still exactly at the stream head
+    assert sidecar._pool.applied_upto[0] == stream_len
+
+
+def test_pool_dispatch_is_idempotent_without_new_ops():
+    """The watermark half of the dedupe story: dispatch_pending with
+    nothing past the watermark is a no-op on both tiers."""
+    import numpy as np
+
+    for route in ("seq", "mesh"):
+        sidecar = _mini_sidecar(route)
+        sidecar.track("d", "a", "c")
+        from fluidframework_tpu.testing import (
+            FuzzConfig,
+            record_op_stream,
+        )
+        from fluidframework_tpu.ops import encode_stream
+
+        _, stream = record_op_stream(FuzzConfig(
+            n_clients=2, n_steps=60, seed=3))
+        enc = encode_stream(stream)
+        sidecar._streams[0] = enc
+        sidecar._queued[0].extend(enc.ops)
+        sidecar.apply()
+        sidecar.sync()
+        if sidecar.pooled_docs():
+            pool = sidecar._pool
+            count_before = pool.dispatch_count
+            text = sidecar.text("d", "a", "c")
+            assert pool.dispatch_pending(sidecar._streams) == []
+            assert pool.dispatch_count == count_before
+            assert sidecar.text("d", "a", "c") == text
+
+
+def test_broker_consume_duplicate_absorbed_by_csn_dedupe():
+    """An at-least-once redelivery on the partitioned consume path:
+    deli's clientSequenceNumber dedupe drops the duplicate and the
+    op log stays contiguous (its append asserts contiguity — a leak
+    here detonates, not corrupts)."""
+    from fluidframework_tpu.qos.faults import PLANE as plane
+    from fluidframework_tpu.service.partitioning import (
+        PartitionedOrderingService,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        ClientDetail,
+        DocumentMessage,
+        MessageType,
+    )
+
+    svc = PartitionedOrderingService(n_partitions=2)
+    svc.produce_join("doc", ClientDetail("w"))
+    site = plane.site("broker.queue_consume")
+    for i in range(1, 6):
+        svc.produce_op("doc", "w", DocumentMessage(
+            client_sequence_number=i,
+            reference_sequence_number=0,
+            type=MessageType.OPERATION,
+            contents={"v": i},
+        ))
+    site.push(KIND_DUPLICATE, 5)  # redeliver EVERY op record
+    svc.pump()
+    orderer = svc.orderer("doc")
+    ops = [m for m in orderer.op_log.read(0)
+           if m.type == MessageType.OPERATION]
+    assert [m.client_sequence_number for m in ops] == [1, 2, 3, 4, 5]
+
+
+def test_broker_append_transient_error_is_retried():
+    from fluidframework_tpu.qos.faults import PLANE as plane
+    from fluidframework_tpu.service.partitioning import (
+        PartitionedOrderingService,
+    )
+    from fluidframework_tpu.protocol.messages import (
+        ClientDetail,
+        DocumentMessage,
+        MessageType,
+    )
+
+    svc = PartitionedOrderingService(n_partitions=1)
+    svc.produce_join("doc", ClientDetail("w"))
+    plane.site("broker.queue_append").push(KIND_ERROR, 1)
+    svc.produce_op("doc", "w", DocumentMessage(
+        client_sequence_number=1, reference_sequence_number=0,
+        type=MessageType.OPERATION, contents={"v": 1}))
+    svc.pump()
+    assert any(
+        m.type == MessageType.OPERATION
+        for m in svc.orderer("doc").op_log.read(0)
+    ), "single transient append fault must be absorbed by the retry"
+
+
+# ----------------------------------------------------------------------
+# real-TCP socket driver seams (site-backed, scripted => determinate)
+
+
+def test_socket_driver_frame_in_drop_recovers_by_gap_refetch(alfred):
+    import time as _time
+
+    from fluidframework_tpu.drivers.socket_driver import (
+        SocketDocumentServiceFactory,
+    )
+    from fluidframework_tpu.loader.container import Container
+
+    server = alfred()
+    factory = SocketDocumentServiceFactory(port=server.port)
+    svc_a = factory.create_document_service("sock-chaos")
+    svc_b = factory.create_document_service("sock-chaos")
+    a = Container.load(svc_a, client_id="a")
+    b = Container.load(svc_b, client_id="b")
+    ds = a.runtime.create_datastore("app")
+    ds.create_channel("sharedstring", "t")
+    with svc_a.lock:
+        a.flush()
+
+    def text(c):
+        return c.runtime.get_datastore("app").get_channel(
+            "t").get_text()
+
+    def wait_for(fn, timeout=10.0):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if fn():
+                return True
+            _time.sleep(0.02)
+        return False
+
+    assert wait_for(lambda: "t" in [
+        c for dsb in [b.runtime.datastores.get("app")] if dsb
+        for c in dsb.channels])
+    before = obs_metrics.REGISTRY.flat()
+    # scripted drops on b's inbound fanout: the next two broadcast op
+    # frames vanish; the FOLLOWING frame exposes the gap and the
+    # driver-side refetch recovers them from delta storage
+    PLANE.site("socket.frame_in").push(KIND_DROP, 2)
+    for i in range(4):
+        with svc_a.lock:
+            a.runtime.get_datastore("app").get_channel(
+                "t").insert_text(0, f"x{i}")
+            a.flush()
+        _time.sleep(0.05)
+    assert wait_for(lambda: text(b) == text(a)), (
+        f"gap refetch failed: a={text(a)!r} b={text(b)!r}")
+    delta = obs_metrics.REGISTRY.delta(before)
+    drops = sum(
+        int(v) for k, v in delta.items()
+        if k.startswith("chaos_injected_total")
+        and 'site="socket.frame_in"' in k)
+    assert drops == 2
+    a.close()
+    b.close()
+    svc_a.close()
+    svc_b.close()
+
+
+# ----------------------------------------------------------------------
+# chaos storm (tools/stress --chaos / bench config11)
+
+
+def test_chaos_storm_dips_and_recovers_deterministically():
+    a = run_chaos_storm(seed=1, steps=90, storm=(30, 60))
+    assert a.converged, a.failures
+    assert a.fired > 0
+    assert a.goodput_dip < a.goodput_steady, (
+        "the storm must dent goodput or it tested nothing")
+    assert a.recovery_steps is not None, (
+        "goodput never recovered to the SLO floor after the storm")
+    b = run_chaos_storm(seed=1, steps=90, storm=(30, 60))
+    assert a.deterministic_fields() == b.deterministic_fields()
+
+
+def test_stress_cli_chaos_mode(tmp_path):
+    from fluidframework_tpu.tools import stress
+
+    rc, out = _run_cli(stress, ["--chaos", "1", "--chaos-steps", "60",
+                                "--chaos-storm", "20", "40"])
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload["seed"] == 1
+    assert payload["converged"] is True
+    assert payload["fired"] > 0
+    assert "goodput_dip" in payload and "recovery_time_s" in payload
+    assert any(k.startswith("chaos_injected_total")
+               for k in payload["chaos_counts"])
+
+
+def _run_cli(mod, argv):
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = mod.main(argv)
+    return rc, buf.getvalue()
+
+
+# ----------------------------------------------------------------------
+# crash-state plumbing details
+
+
+def test_harness_refuses_to_tear_fanned_out_oplog_ops(tmp_path):
+    """The fsync-before-fanout barrier: an op a client processed is
+    durable by contract, so the harness must SKIP (and record) a tear
+    that would violate it."""
+    from fluidframework_tpu.loader.container import Container
+    from fluidframework_tpu.testing.chaos import DOC_ALPHA
+
+    harness = ChaosHarness(str(tmp_path))
+    svc = harness.service_for(DOC_ALPHA, "w")
+    c = Container.load(svc, client_id="w")
+    ds = c.runtime.create_datastore("app")
+    ds.create_channel("sharedstring", "t")
+    ds.get_channel("t").insert_text(0, "hello")
+    c.flush()
+    harness.pump()  # the client PROCESSES its ops: tail is fanned out
+    oplog = os.path.join(str(tmp_path), DOC_ALPHA, "ops.jsonl")
+    size = os.path.getsize(oplog)
+    harness.crash(tear="oplog_tail", containers=[c])
+    assert os.path.getsize(oplog) == size, (
+        "tear applied to a fanned-out op — the barrier says this "
+        "crash state is unreachable")
+    c.close()
+
+
+def test_site_registered_after_arm_gets_a_stream():
+    """A seam first imported AFTER a schedule is armed (lazy imports
+    mid-run) must still fire — a streamless site would silently skip
+    the whole armed window."""
+    schedule = FaultSchedule(
+        2, rates={"test.late_reg": {KIND_DROP: 1.0}})
+    with PLANE.while_armed(schedule):
+        site = PLANE.site("test.late_reg", (KIND_DROP,))
+        assert site.fire() == KIND_DROP
+
+
+def test_socket_driver_held_frame_releases_on_idle_wire(alfred):
+    """A chaos-REORDERED broadcast frame held by the recv pump must
+    release after HELD_FLUSH_S on an idle connection — gap detection
+    needs a NEXT frame, and with no follow-on traffic a held frame
+    would otherwise stall the replica until the socket timeout."""
+    import time as _time
+
+    from fluidframework_tpu.drivers.socket_driver import (
+        SocketDocumentServiceFactory,
+    )
+    from fluidframework_tpu.loader.container import Container
+    from fluidframework_tpu.qos.faults import KIND_REORDER
+
+    server = alfred()
+    factory = SocketDocumentServiceFactory(port=server.port)
+    svc_a = factory.create_document_service("sock-hold")
+    svc_b = factory.create_document_service("sock-hold")
+    a = Container.load(svc_a, client_id="a")
+    b = Container.load(svc_b, client_id="b")
+    ds = a.runtime.create_datastore("app")
+    ds.create_channel("sharedstring", "t")
+    with svc_a.lock:
+        a.flush()
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline:
+        with svc_b.lock:
+            dsb = b.runtime.datastores.get("app")
+            if dsb is not None and "t" in dsb.channels:
+                break
+        _time.sleep(0.02)
+    # hold the NEXT broadcast op on every recv pump, then go idle
+    PLANE.site("socket.frame_in").push(KIND_REORDER, 2)
+    with svc_a.lock:
+        a.runtime.get_datastore("app").get_channel(
+            "t").insert_text(0, "held")
+        a.flush()
+    deadline = _time.monotonic() + 10
+    ok = False
+    while _time.monotonic() < deadline:
+        with svc_b.lock:
+            if b.runtime.get_datastore("app").get_channel(
+                    "t").get_text() == "held":
+                ok = True
+                break
+        _time.sleep(0.02)
+    assert ok, "held frame never released on the idle wire"
+    a.close()
+    b.close()
+    svc_a.close()
+    svc_b.close()
+
+
+def test_schedule_rng_for_is_stable():
+    s = standard_schedule(9)
+    assert s.rng_for("x").random() == s.rng_for("x").random()
+    assert s.rng_for("x").random() != s.rng_for("y").random()

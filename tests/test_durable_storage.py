@@ -275,3 +275,163 @@ def test_service_survives_kill_and_restart(tmp_path):
     finally:
         server.kill()
         server.wait()
+
+# ----------------------------------------------------------------------
+# crash-atomicity: the enumerated torn states (chaos PR; the write
+# barriers are write-temp+fsync+rename for the checkpoint and
+# fsync-before-fanout for the op log — docs/ROBUSTNESS.md)
+
+def _drive_some_ops(durable_dir, n=5):
+    """Crash-shaped teardown: the container is ABANDONED, not closed
+    — a crash sequences no client-leave, so the log's tail is the
+    last real op (what the tear tests truncate)."""
+    server = LocalServer(durable_dir=str(durable_dir))
+    factory = LocalDocumentServiceFactory(server)
+    c = Container.load(factory.create_document_service("torn-doc"),
+                       client_id="w")
+    ds = c.runtime.create_datastore("app")
+    ds.create_channel("sharedstring", "t")
+    text = c.runtime.get_datastore("app").get_channel("t")
+    for i in range(n):
+        text.insert_text(0, f"x{i}.")
+        c.flush()
+    final = text.get_text()
+    return server, final
+
+
+def _reload_text(durable_dir):
+    server = LocalServer(durable_dir=str(durable_dir))
+    factory = LocalDocumentServiceFactory(server)
+    c = Container.load(factory.create_document_service("torn-doc"),
+                       client_id="r")
+    out = c.runtime.get_datastore("app").get_channel("t").get_text()
+    c.close()
+    return server, out
+
+
+def test_torn_checkpoint_final_recovers_from_op_log(tmp_path):
+    """The reordered-write crash state (rename durable before data —
+    what the missing fsync used to permit): a prefix-truncated
+    checkpoint.json parses as garbage. read_checkpoint must degrade
+    LOUDLY to None and the restart fast-forwards the full op log."""
+    _, final = _drive_some_ops(tmp_path)
+    ckpt = tmp_path / "torn-doc" / "checkpoint.json"
+    data = ckpt.read_bytes()
+    ckpt.write_bytes(data[: len(data) // 2])
+    server, text = _reload_text(tmp_path)
+    assert text == final
+    # and sequencing continues contiguously after the recovery
+    orderer = server.get_orderer("torn-doc")
+    last = orderer.op_log.last_seq
+    orderer.connect(__import__(
+        "fluidframework_tpu.protocol.messages",
+        fromlist=["ClientDetail"]).ClientDetail("w2"))
+    assert orderer.op_log.last_seq == last + 1
+
+
+def test_crash_between_checkpoint_write_and_rename(tmp_path):
+    """A torn .tmp beside the intact checkpoint (crash inside the
+    write-temp+fsync+rename window): the committed checkpoint is the
+    truth; the debris is cleared on reload."""
+    _, final = _drive_some_ops(tmp_path)
+    tmp = tmp_path / "torn-doc" / "checkpoint.json.tmp"
+    tmp.write_bytes(b'{"sequencer": {"torn')
+    _, text = _reload_text(tmp_path)
+    assert text == final
+    assert not tmp.exists(), "stale checkpoint tmp must be cleared"
+
+
+def test_torn_oplog_tail_is_discarded_and_rewritten(tmp_path):
+    """Crash mid-append: a partial final JSONL line. The loader
+    discards exactly that op (never fanned out, so no client has it
+    — the fsync-before-fanout barrier) and rewrites the log so a
+    second crash cannot stack onto the half record."""
+    _, final = _drive_some_ops(tmp_path)
+    oplog = tmp_path / "torn-doc" / "ops.jsonl"
+    lines = oplog.read_bytes().splitlines(keepends=True)
+    torn_away = json.loads(lines[-1])
+    oplog.write_bytes(b"".join(lines[:-1]) + lines[-1][: len(lines[-1]) // 2])
+    server, text = _reload_text(tmp_path)
+    # the torn op's insert is gone (x4.), everything before it intact
+    assert text == final.replace("x4.", "", 1)
+    # the log was re-truncated to whole records and new sequencing
+    # continues contiguously from the surviving head: the torn op's
+    # seq slot is REUSED (here by the reader's join) — never left as
+    # a gap, never still holding the torn OPERATION
+    reread = [json.loads(ln) for ln in
+              oplog.read_bytes().splitlines() if ln.strip()]
+    seqs = [r["sequenceNumber"] for r in reread]
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+    reused = [r for r in reread
+              if r["sequenceNumber"] == torn_away["sequenceNumber"]]
+    assert all(r["type"] != torn_away["type"] for r in reused)
+    orderer = server.get_orderer("torn-doc")
+    assert orderer.sequencer.sequence_number == \
+        orderer.op_log.last_seq
+
+
+def test_torn_middle_oplog_line_is_corruption_not_crash(tmp_path):
+    """A malformed line ANYWHERE but the tail is not a legal crash
+    state (appends are sequential + fsynced): refuse loudly."""
+    _, _ = _drive_some_ops(tmp_path)
+    oplog = tmp_path / "torn-doc" / "ops.jsonl"
+    lines = oplog.read_bytes().splitlines(keepends=True)
+    lines[1] = lines[1][: len(lines[1]) // 2].rstrip() + b"\n"
+    oplog.write_bytes(b"".join(lines))
+    with pytest.raises(ValueError, match="corrupt at line 2"):
+        _reload_text(tmp_path)
+
+
+def test_torn_versions_tail_is_discarded_and_rewritten(tmp_path):
+    """A torn versions.jsonl tail must be REWRITTEN on load, not just
+    skipped: the next commit_summary appends, and stacking a fresh
+    record onto the half line would turn a recoverable crash state
+    into mid-file corruption at the load after that."""
+    from fluidframework_tpu.service.storage import DocumentStorage
+
+    st = DocumentStorage(str(tmp_path / "doc"))
+    st.write_summary(1, {"runtime": {"a": 1}})
+    st.write_summary(2, {"runtime": {"a": 2}})
+    vpath = tmp_path / "doc" / "versions.jsonl"
+    lines = vpath.read_bytes().splitlines(keepends=True)
+    vpath.write_bytes(b"".join(lines[:-1]) + lines[-1][:10])
+    st2 = DocumentStorage(str(tmp_path / "doc"))
+    assert [v.sequence_number for v in st2.versions] == [1]
+    # the append after recovery lands on a CLEAN file...
+    st2.write_summary(3, {"runtime": {"a": 3}})
+    # ...so the next load parses every line (no mid-file corruption)
+    st3 = DocumentStorage(str(tmp_path / "doc"))
+    assert [v.sequence_number for v in st3.versions] == [1, 3]
+
+
+def test_gap_over_truncated_log_raises_actionably(tmp_path):
+    """A replica behind a summary-truncated log whose reconnect-time
+    catch-up was EMPTY (no trailing ops yet) must fail with the loud
+    truncation error when the next fanout exposes the unfillable gap
+    — not the bare inbound-contiguity assert."""
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    a = Container.load(factory.create_document_service("trunc-doc"),
+                       client_id="a")
+    ds = a.runtime.create_datastore("app")
+    ds.create_channel("sharedstring", "t")
+    for i in range(4):
+        ds.get_channel("t").insert_text(0, f"x{i}")
+        a.flush()
+    b = Container.load(factory.create_document_service("trunc-doc"),
+                       client_id="b")
+    b.disconnect()
+    # while b is offline: more ops, then a summary truncates the log
+    # above b's position, then NO trailing ops before b reconnects
+    for i in range(3):
+        ds.get_channel("t").insert_text(0, f"y{i}")
+        a.flush()
+    orderer = server.get_orderer("trunc-doc")
+    orderer.op_log.truncate_below(orderer.sequencer.sequence_number)
+    # reconnect: the direct catch-up read is empty (nothing trails
+    # the truncation), but the join broadcast immediately exposes the
+    # unfillable gap — loud and actionable, not the bare contiguity
+    # assert three frames later
+    with pytest.raises(RuntimeError, match="not in delta storage"):
+        b.connect()
+    a.close()

@@ -252,6 +252,93 @@ def test_service_unbounded_queue_rule_fires_in_service_paths(
     ) == []
 
 
+def test_retry_without_jitter_rule(tmp_path):
+    """qoscheck:retry-without-jitter — a constant time.sleep inside a
+    retry/reconnect loop in drivers/service/qos paths flags
+    (synchronized reconnect storms after a mass disconnect); delays
+    routed through driver_utils.full_jitter_delay pass, as do sleeps
+    outside loops, unknown-provenance values, suppressed lines and
+    out-of-scope paths."""
+    drv = tmp_path / "drivers"
+    drv.mkdir()
+    bad = drv / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "from .driver_utils import full_jitter_delay\n"
+        "class Conn:\n"
+        "    def reconnect(self):\n"
+        "        attempt = 0\n"
+        "        while True:\n"
+        "            try:\n"
+        "                return self.dial()\n"
+        "            except OSError:\n"
+        "                attempt += 1\n"
+        "                time.sleep(0.5)\n"                     # BAD
+        "    def reconnect_scaled(self):\n"
+        "        delay = 0.1 * 2\n"
+        "        for _ in range(5):\n"
+        "            time.sleep(delay)\n"                       # BAD
+        "    def reconnect_jittered(self, attempt):\n"
+        "        while True:\n"
+        "            time.sleep(full_jitter_delay(attempt))\n"  # ok
+        "    def reconnect_jittered_var(self, attempt):\n"
+        "        while True:\n"
+        "            d = full_jitter_delay(attempt)\n"
+        "            time.sleep(d)\n"                           # ok
+        "    def settle_once(self):\n"
+        "        time.sleep(0.5)\n"          # ok: not a retry loop
+        "    def injected(self, delay_fn):\n"
+        "        while True:\n"
+        "            time.sleep(delay_fn())\n"  # ok: unknown prov
+        "    def justified(self):\n"
+        "        while True:\n"
+        "            time.sleep(1.0)  "
+        "# fluidlint: disable=retry-without-jitter -- test\n"
+    )
+    findings = core.run_analysis(
+        roots=[str(bad)], families=["qoscheck"],
+    )
+    assert sorted(f.key for f in findings) == [
+        "bad.py:Conn.reconnect.sleep",
+        "bad.py:Conn.reconnect_scaled.sleep",
+    ]
+    assert all(f.rule == "retry-without-jitter" for f in findings)
+
+    # two raw sleeps in ONE scope get distinct stable keys
+    two = drv / "two.py"
+    two.write_text(
+        "import time\n"
+        "def pump():\n"
+        "    while True:\n"
+        "        time.sleep(0.1)\n"
+        "        time.sleep(0.2)\n"
+    )
+    keys = sorted(f.key for f in core.run_analysis(
+        roots=[str(two)], families=["qoscheck"]))
+    assert keys == ["two.py:pump.sleep", "two.py:pump.sleep2"]
+
+    # the same code OUTSIDE a drivers/service/qos path component is
+    # not the rule's business
+    other = tmp_path / "elsewhere.py"
+    other.write_text(
+        "import time\n"
+        "def pump():\n"
+        "    while True:\n"
+        "        time.sleep(0.1)\n"
+    )
+    assert core.run_analysis(
+        roots=[str(other)], families=["qoscheck"],
+    ) == []
+
+
+def test_retry_without_jitter_live_tree_is_clean():
+    findings = [
+        f for f in core.run_analysis(families=["qoscheck"])
+        if f.rule == "retry-without-jitter"
+    ]
+    assert findings == [], [f.key for f in findings]
+
+
 def test_qoscheck_family_is_in_the_gate():
     assert "qoscheck" in core.FAMILIES
 
