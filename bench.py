@@ -42,7 +42,7 @@ import time
 
 STAGES = ("probe", "fuzz", "config1", "config2", "config3", "config4",
           "config5", "config6", "config7", "config8", "config9",
-          "config10", "config11")
+          "config10", "config11", "config12")
 
 # Machine-readable corpus identity, stamped into EVERY stage record
 # (r5 silently changed the stream mix — flow-mix quarter joined — and
@@ -65,6 +65,7 @@ STAGE_CORPUS = {
     "config9": {"generator": "open-loop-poisson", "version": 1},
     "config10": {"generator": "mesh-hotspot", "version": 1},
     "config11": {"generator": "chaos-standard", "version": 1},
+    "config12": {"generator": "chaos-failover", "version": 1},
 }
 
 
@@ -2121,6 +2122,107 @@ def stage_config11(scale: str, reps: int, cooldown: float) -> dict:
     }
 
 
+def stage_config12(scale: str, reps: int, cooldown: float) -> dict:
+    """Replicated-sequencer failover under chaos (ROADMAP item 3,
+    docs/ROBUSTNESS.md "Replication & failover"): the config11 storm
+    over the REPLICATED plane with the leader KILLED mid-storm —
+    reporting ``failover_time_s`` (step clock from host loss to the
+    first post-failover ack) and ``repl_lag_max`` next to
+    ``goodput_dip``/``recovery_time_s``, x2 runs bit-equal. A
+    convergence leg runs the kill-the-leader differential (one seed
+    per enumerated kill mode: mid-batch, promotion under replication
+    lag, deposed-leader fenced write) against the fault-free oracle
+    and FAILS the round on any divergence."""
+    from fluidframework_tpu.testing.chaos import (
+        failover_plan,
+        run_chaos,
+        run_chaos_failover,
+        run_chaos_storm,
+    )
+
+    steps, storm = {
+        "full": (240, (80, 160)),
+        "cpu": (120, (40, 80)),
+        "smoke": (60, (20, 40)),
+    }[scale]
+    kill_step = sum(storm) // 2  # mid-storm: the interesting window
+
+    # --- storm leg: failover time next to goodput dip ----------------
+    t0 = time.perf_counter()
+    storm_rep = run_chaos_storm(seed=12, steps=steps, storm=storm,
+                                kill_leader_step=kill_step)
+    storm_wall = time.perf_counter() - t0
+    assert storm_rep.converged, (
+        f"config12 storm diverged: {storm_rep.failures}")
+    assert storm_rep.failover_time_s is not None and \
+        storm_rep.failovers >= 1, (
+            "config12's leader kill never failed over")
+    again = run_chaos_storm(seed=12, steps=steps, storm=storm,
+                            kill_leader_step=kill_step)
+    assert again.deterministic_fields() == \
+        storm_rep.deterministic_fields(), (
+            "config12 determinism violation: "
+            f"{again.deterministic_fields()} != "
+            f"{storm_rep.deterministic_fields()}")
+
+    # --- convergence leg: one seed per enumerated kill mode ----------
+    oracle = run_chaos(0, faults=False)
+    assert oracle.converged, oracle.failures
+    # seeds 1/2/6: mid_batch, under_lag, deposed_race (failover_plan
+    # is a pure function of the seed — asserted, not assumed)
+    diff = []
+    want_modes = {"mid_batch", "under_lag", "deposed_race"}
+    for seed in (1, 2, 6):
+        rep = run_chaos_failover(seed)
+        assert rep.converged and \
+            rep.alpha_text == oracle.alpha_text and \
+            rep.beta_text == oracle.beta_text, (
+                f"config12 failover differential FAILED for seed "
+                f"{seed} (reproduce: run_chaos_failover({seed})): "
+                f"{rep.failures}")
+        diff.append({
+            "seed": seed,
+            "kill_mode": rep.kill_mode,
+            "failovers": rep.failovers,
+            "fenced_writes": rep.fenced_writes,
+            "repl_lag_max": rep.repl_lag_max,
+            "fired": len(rep.fired),
+        })
+    got_modes = {d["kill_mode"] for d in diff}
+    assert got_modes == want_modes, (
+        f"config12 kill-mode coverage: {got_modes} != {want_modes} "
+        f"(failover_plan: {[failover_plan(s, 40) for s in (1, 2, 6)]})")
+    deposed = [d for d in diff if d["kill_mode"] == "deposed_race"]
+    assert deposed and deposed[0]["fenced_writes"] > 0, (
+        "the deposed-leader seed must record fenced writes — the "
+        "epoch fence refusing the split-brain candidate IS the test")
+
+    return {
+        "steps": steps,
+        "storm_window": list(storm),
+        "kill_leader_step": kill_step,
+        "failover_time_s": storm_rep.failover_time_s,
+        "failovers": storm_rep.failovers,
+        "repl_lag_max": storm_rep.repl_lag_max,
+        "offered_ops": storm_rep.offered_ops,
+        "acked_ops": storm_rep.acked_ops,
+        "goodput_steady": round(storm_rep.goodput_steady, 4),
+        "goodput_dip": round(storm_rep.goodput_dip, 4),
+        "recovery_steps": storm_rep.recovery_steps,
+        "recovery_time_s": storm_rep.recovery_time_s,
+        "faults_fired": storm_rep.fired,
+        "chaos_counts": storm_rep.chaos_counts,
+        "failover_runs": diff,
+        "kernel_ops_per_sec": round(
+            storm_rep.acked_ops / max(storm_wall, 1e-9), 1),
+        "wall_s": round(storm_wall, 3),
+        "deterministic": "step clock, seeded schedule, x2 "
+                         "kill-leader storms bit-equal; failover "
+                         "differential asserts oracle equality for "
+                         "every enumerated kill mode",
+    }
+
+
 STAGE_FNS = {
     "probe": stage_probe,
     "fuzz": stage_fuzz,
@@ -2135,6 +2237,7 @@ STAGE_FNS = {
     "config9": stage_config9,
     "config10": stage_config10,
     "config11": stage_config11,
+    "config12": stage_config12,
 }
 
 

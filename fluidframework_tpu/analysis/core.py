@@ -213,7 +213,8 @@ FAMILY_RULES = {
                    "dispatch-loop-sync"),
     "lockcheck": ("lock-unlocked-write", "lock-external-write"),
     "obscheck": ("obs-untimed-hop", "slo-unbound-objective"),
-    "qoscheck": ("service-unbounded-queue", "retry-without-jitter"),
+    "qoscheck": ("service-unbounded-queue", "retry-without-jitter",
+                 "fence-before-fanout"),
     "concheck": ("lock-order-cycle", "async-blocking-call",
                  "await-holding-lock"),
     "shapecheck": ("donated-buffer-reuse", "unladdered-jit-shape",

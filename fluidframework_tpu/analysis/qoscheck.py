@@ -34,6 +34,21 @@ Clean: the slept value flows from a ``full_jitter_delay(...)`` call
 attributes, other calls) is trusted — the arithmetic-with-names
 backoff (``base * 2 ** attempt``: exponential but unjittered) is a
 documented false negative; route it through the helper anyway.
+
+``fence-before-fanout``: inside the replicated sequencer, the calls
+that release a sequenced op toward fan-out (the reviewed
+``FANOUT_GATES`` registry — ``replicate_before_fanout`` and its
+underscore twin, on both the document plane and the partitioned
+queue) MUST be textually preceded, in the same function, by an epoch
+fence check (``<...>.fence.check(...)`` or a ``check_epoch(...)``
+call). A deposed leader that fans out before checking the fence is
+the split-brain failure the whole replication design exists to rule
+out (docs/ROBUSTNESS.md "Replication & failover"); the runtime half
+is ``EpochFence.check`` raising ``FencedWriteError`` + the
+follower-side stale-epoch refusal, and this rule pins the ordering
+statically so a refactor cannot silently move the fan-out above the
+fence. Scope: ``service`` path components (where the replicated
+sequencer lives).
 """
 from __future__ import annotations
 
@@ -249,6 +264,101 @@ def _check_retry_jitter(src: SourceFile, aliases: dict,
             walk(child, False, scope)
 
 
+#: reviewed registry: the replication gates — calls that release a
+#: sequenced op toward fan-out in the replicated sequencer. Adding a
+#: new gate spelling here is a REVIEWED change (the rule's coverage
+#: is only as good as this list).
+FANOUT_GATES = ("replicate_before_fanout", "_replicate_before_fanout")
+
+#: bare-call fence spellings; ``<...>.fence.check(...)`` is always
+#: recognized structurally
+FENCE_CALLS = ("check_epoch",)
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_fence_check(node: ast.Call) -> bool:
+    name = _callee_name(node.func)
+    if name in FENCE_CALLS:
+        return True
+    if name != "check" or not isinstance(node.func, ast.Attribute):
+        return False
+    value = node.func.value
+    # <anything>.fence.check(...) / fence.check(...)
+    if isinstance(value, ast.Attribute) and value.attr == "fence":
+        return True
+    return isinstance(value, ast.Name) and value.id == "fence"
+
+
+def _check_fence_before_fanout(src: SourceFile, module: str,
+                               findings: list) -> None:
+    quals: dict[ast.AST, str] = {}
+    for cls in ast.walk(src.tree):
+        if isinstance(cls, ast.ClassDef):
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    quals[item] = f"{cls.name}.{item.name}"
+    def own_calls(scope) -> list[ast.Call]:
+        """Calls in the scope's OWN body — nested defs are their own
+        scopes (a fence check inside a nested helper does not guard
+        the outer function's gate, and a nested gate must not be
+        double-reported against the outer scope)."""
+        out: list[ast.Call] = []
+
+        def walk(node) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Call):
+                    out.append(child)
+                walk(child)
+
+        walk(scope)
+        return out
+
+    for scope in ast.walk(src.tree):
+        if not isinstance(scope, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+            continue
+        calls = own_calls(scope)
+        fences = sorted((n.lineno, n.col_offset) for n in calls
+                        if _is_fence_check(n))
+        hits = 0
+        for call in sorted(calls, key=lambda n: (n.lineno,
+                                                 n.col_offset)):
+            if _callee_name(call.func) not in FANOUT_GATES:
+                continue
+            pos = (call.lineno, call.col_offset)
+            if any(f < pos for f in fences):
+                continue
+            hits += 1
+            qual = quals.get(scope, scope.name)
+            suffix = "" if hits == 1 else str(hits)
+            findings.append(Finding(
+                rule="fence-before-fanout",
+                path=src.relpath, line=call.lineno,
+                message=(
+                    f"{_callee_name(call.func)}() releases a "
+                    "sequenced op toward fan-out without an epoch "
+                    "fence check earlier in this function: a "
+                    "deposed leader (split-brain candidate) could "
+                    "fan out an op the quorum will refuse — call "
+                    "<...>.fence.check(epoch) (or check_epoch) "
+                    "first (docs/ROBUSTNESS.md)"
+                ),
+                key=f"{module}:{qual}.fanout{suffix}",
+            ))
+
+
 def check(files: list[SourceFile]) -> list[Finding]:
     findings: list[Finding] = []
     for src in files:
@@ -262,6 +372,7 @@ def check(files: list[SourceFile]) -> list[Finding]:
             continue
         aliases = _import_aliases(src.tree)
         module = src.relpath.rsplit("/", 1)[-1]
+        _check_fence_before_fanout(src, module, findings)
         parents: dict = {}
         for parent in ast.walk(src.tree):
             for child in ast.iter_child_nodes(parent):

@@ -331,6 +331,14 @@ def standard_rates(sites: Optional[list[str]] = None
         "sidecar.pool_admit": {KIND_ERROR: 0.25},
         "sidecar.pool_migrate": {KIND_DEFER: 0.25},
         "ingress.summary_upload": {KIND_ERROR: 0.30},
+        # replicated sequencer seams (service/replication.py +
+        # partitioning's queue counterpart): follower lag, lost/
+        # erroring acks, lease renewal loss + spurious lapse (the
+        # split-brain trigger), transient election failures
+        "repl.lag": {KIND_DEFER: 0.15},
+        "repl.append_ack": {KIND_DROP: 0.04, KIND_ERROR: 0.02},
+        "repl.lease_expire": {KIND_DROP: 0.03, KIND_ERROR: 0.01},
+        "repl.promote": {KIND_ERROR: 0.25},
     }
     if sites is not None:
         unknown = set(sites) - set(rates)

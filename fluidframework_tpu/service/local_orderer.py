@@ -33,13 +33,20 @@ class LocalOrderer:
 
     def __init__(self, document_id: str, lumberjack=None,
                  storage=None, checkpoint_every: int = 1,
-                 storage_breaker=None):
+                 storage_breaker=None, write_fence=None):
         import os
 
         from .telemetry import Lumberjack
         self.document_id = document_id
         self.lumberjack = lumberjack or Lumberjack()
         self.storage = storage
+        # optional epoch-fence hook (service/replication.py), called
+        # with the operation name ("submit"/"connect"/"disconnect" —
+        # the truthful context for refusal diagnostics): consulted
+        # BEFORE ticketing, so a deposed leader refuses a write
+        # without consuming a sequence number — its sequencer state
+        # stays aligned with its (refused) log
+        self.write_fence = write_fence
         # optional qos.CircuitBreaker around checkpoint writes: a
         # hard-down disk degrades durability (the op log still has
         # every op; restart fast-forwards from it) instead of taking
@@ -103,9 +110,16 @@ class LocalOrderer:
             # lost/absent checkpoint entirely) are in the durable log;
             # fast-forward the stream position so new tickets continue
             # the contiguous order
-            gap = self.op_log.last_seq - self.sequencer.sequence_number
-            for _ in range(max(0, gap)):
-                self.sequencer.system_message(MessageType.NO_OP, None)
+            if hasattr(self.sequencer, "fast_forward"):
+                self.sequencer.fast_forward(self.op_log.last_seq)
+            else:
+                # implementations without the O(1) resume (the native
+                # core) walk the gap the old way
+                gap = (self.op_log.last_seq
+                       - self.sequencer.sequence_number)
+                for _ in range(max(0, gap)):
+                    self.sequencer.system_message(
+                        MessageType.NO_OP, None)
             # scribe's replica must fast-forward with the log too, or
             # the first post-restart message trips its contiguity
             # check (scribe/lambda.ts:108 skips below-checkpoint
@@ -133,11 +147,30 @@ class LocalOrderer:
     # ingress (alfred submitOp path)
 
     def connect(self, detail: ClientDetail) -> SequencedMessage:
+        if self.write_fence is not None:
+            # refuse BEFORE the join consumes a sequence number: a
+            # deposed leader's sequencer must stay aligned with its
+            # (refused) log, or the unwind path's leave trips the
+            # log-contiguity assert instead of the fence
+            self.write_fence("connect")
         join = self.sequencer.client_join(detail)
         self._dispatch(join)
         return join
 
     def disconnect(self, client_id: str) -> Optional[SequencedMessage]:
+        if self.write_fence is not None:
+            from .replication import FencedWriteError
+
+            try:
+                self.write_fence("disconnect")
+            except FencedWriteError:
+                # teardown on a DEPOSED node must not detonate:
+                # session close() runs this mid-cleanup (a transport
+                # death during the deposed window), and a leave a
+                # fenced node sequences could never reach a client
+                # anyway — skip sequencing it; the client's lifecycle
+                # continues on the real leader
+                return None
         leave = self.sequencer.client_leave(client_id)
         if leave is not None:
             self._dispatch(leave)
@@ -145,6 +178,9 @@ class LocalOrderer:
 
     def submit(self, client_id: str,
                op: DocumentMessage) -> Optional[Nack]:
+        if self.write_fence is not None:
+            # raises FencedWriteError when deposed
+            self.write_fence("submit")
         result = self.sequencer.ticket(client_id, op)
         if result.nack is not None:
             # structured service telemetry (Lumberjack, lumber.ts:23)

@@ -76,21 +76,30 @@ class LocalServer:
 
     def get_orderer(self, document_id: str) -> LocalOrderer:
         if document_id not in self.documents:
-            storage = None
-            if self.durable_dir is not None:
-                import os
-
-                from .storage import DocumentStorage
-
-                storage = DocumentStorage(
-                    os.path.join(self.durable_dir, document_id)
-                )
-            self.documents[document_id] = LocalOrderer(
-                document_id, storage=storage,
-                storage_breaker=self.storage_breaker,
-                checkpoint_every=self.checkpoint_every,
-            )
+            self.documents[document_id] = self._make_orderer(
+                document_id)
         return self.documents[document_id]
+
+    # factory hooks: the replicated sequencer
+    # (service/replication.py) swaps in a ReplicatedDocumentStorage
+    # (op log behind the replication quorum) and an epoch-fenced
+    # orderer without re-stating the construction logic
+    def _make_storage(self, document_id: str):
+        if self.durable_dir is None:
+            return None
+        import os
+
+        from .storage import DocumentStorage
+
+        return DocumentStorage(
+            os.path.join(self.durable_dir, document_id))
+
+    def _make_orderer(self, document_id: str) -> LocalOrderer:
+        return LocalOrderer(
+            document_id, storage=self._make_storage(document_id),
+            storage_breaker=self.storage_breaker,
+            checkpoint_every=self.checkpoint_every,
+        )
 
     # ------------------------------------------------------------------
     # connection lifecycle (connect_document handshake,

@@ -37,12 +37,17 @@ from typing import Any, Callable, Iterator, Optional
 
 from ..protocol.messages import ClientDetail, DocumentMessage, Nack
 from ..qos.faults import (
+    KIND_DROP,
     KIND_DUPLICATE,
     KIND_ERROR,
     PLANE as _CHAOS,
 )
 from .local_orderer import LocalOrderer
-from .storage import DocumentStorage
+from .storage import (
+    DocumentStorage,
+    atomic_write,
+    read_offset_tolerant,
+)
 
 # chaos seams (docs/ROBUSTNESS.md): the consume side replays a record
 # (at-least-once redelivery — deli's clientSequenceNumber dedupe must
@@ -51,6 +56,11 @@ from .storage import DocumentStorage
 # retry)
 _SITE_APPEND = _CHAOS.site("broker.queue_append", (KIND_ERROR,))
 _SITE_CONSUME = _CHAOS.site("broker.queue_consume", (KIND_DUPLICATE,))
+# the partitioned plane shares the document plane's replication site
+# (one schedule drives both harnesses — the socket.frame_* idiom;
+# service/replication.py registers the same name)
+_SITE_REPL_ACK = _CHAOS.site("repl.append_ack",
+                             (KIND_DROP, KIND_ERROR))
 
 
 def partition_for(document_id: str, n_partitions: int) -> int:
@@ -147,9 +157,17 @@ class FileOrderingQueue(OrderingQueue):
 
     fanout_lag_is_local = True  # counters in memory, no I/O
 
-    def __init__(self, root: str, n_partitions: int):
+    def __init__(self, root: str, n_partitions: int,
+                 fsync: bool = False):
         self.root = root
         self.n_partitions = n_partitions
+        # fsync-per-produce: the replicated queue turns this on for
+        # itself and its follower roots — its quorum-durability claim
+        # is only as strong as each node's own write barrier. The
+        # plain single-box queue keeps the cheaper buffered append
+        # (its durability story is the per-document op log, as in
+        # PR9).
+        self.fsync = fsync
         os.makedirs(root, exist_ok=True)
         self._counts = [0] * n_partitions
         self._committed = [-1] * n_partitions
@@ -163,8 +181,18 @@ class FileOrderingQueue(OrderingQueue):
                 with open(self._log_path(p)) as f:
                     self._counts[p] = sum(1 for _ in f)
             if os.path.exists(self._commit_path(p)):
-                with open(self._commit_path(p)) as f:
-                    self._committed[p] = int(f.read().strip() or -1)
+                # tolerant parse: a pre-barrier torn overwrite (or any
+                # garbage) degrades loudly to "no commit" — the
+                # consumer re-reads from the head and the deli csn
+                # dedupe absorbs the at-least-once replay
+                self._committed[p] = read_offset_tolerant(
+                    self._commit_path(p), label="queue-offset")
+            # a leftover commit tmp is the crash-between-write-and-
+            # rename state: the committed file is the truth
+            try:
+                os.remove(self._commit_path(p) + ".tmp")
+            except OSError:
+                pass
 
     def _log_path(self, p: int) -> str:
         return os.path.join(self.root, f"partition-{p}.jsonl")
@@ -179,6 +207,9 @@ class FileOrderingQueue(OrderingQueue):
             f.write(json.dumps(
                 {"document_id": document_id, "payload": payload}
             ) + "\n")
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
         self._counts[partition] = offset + 1
         return offset
 
@@ -212,10 +243,12 @@ class FileOrderingQueue(OrderingQueue):
     def commit(self, partition: int, offset: int) -> None:
         if offset <= self._committed[partition]:
             return
-        tmp = self._commit_path(partition) + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(str(offset))
-        os.replace(tmp, self._commit_path(partition))
+        # the shared crash-atomic barrier (storage.atomic_write): the
+        # plain overwrite this replaced could leave a TORN offset
+        # file — a prefix like "1" of "15" silently rewinds the
+        # checkpoint (absorbed, but slow) and garbage used to crash
+        # the load (tests/test_durable_storage.py pins both states)
+        atomic_write(self._commit_path(partition), str(offset))
         self._committed[partition] = offset
 
     def fanout_lag(self) -> int:
@@ -226,6 +259,169 @@ class FileOrderingQueue(OrderingQueue):
             count - 1 - committed
             for count, committed in zip(self._counts, self._committed)
         )
+
+
+# ----------------------------------------------------------------------
+# Replicated counterparts (service/replication.py is the document-
+# plane half; these are the PARTITIONED plane's: the per-partition
+# queue log replicates to follower roots behind the same quorum ack,
+# and the committed offset mirrors so a promoted follower resumes at
+# the replicated head + checkpoint)
+
+
+class ReplicatedFileOrderingQueue(FileOrderingQueue):
+    """FileOrderingQueue with per-partition log replication to N
+    follower roots behind a quorum ack — fsync-and-replicate-before-
+    fanout for the partitioned plane (every node in the replica set
+    fsyncs its appends; the plain queue's buffered write would make
+    the quorum claim hollow) — and an epoch fence: given a SHARED
+    ``fence`` (it models the external lease/coordination service;
+    ``fence=None`` means fencing is explicitly off), a deposed
+    producer's appends are refused before any consumer could see
+    them. Promotion goes through :meth:`promote`, which — exactly
+    like the document plane — anti-entropies the best-replicated
+    follower root against every surviving peer first: under dropped
+    acks a single follower may legitimately lag, and serving IT
+    directly would lose quorum-acked records."""
+
+    def __init__(self, root: str, n_partitions: int,
+                 follower_roots: list[str],
+                 quorum: Optional[int] = None,
+                 fence: Optional[Any] = None,
+                 epoch: Optional[int] = None):
+        from .replication import _G_FOLLOWERS
+
+        super().__init__(root, n_partitions, fsync=True)
+        if not follower_roots:
+            raise ValueError(
+                "a replicated queue needs at least one follower root")
+        self.followers = [
+            FileOrderingQueue(r, n_partitions, fsync=True)
+            for r in follower_roots
+        ]
+        self.quorum = quorum if quorum is not None else 2
+        if self.quorum > 1 + len(self.followers):
+            raise ValueError(
+                f"quorum {self.quorum} unsatisfiable with "
+                f"{len(self.followers)} followers")
+        # fencing requires a SHARED EpochFence (it models the external
+        # lease/coordination service — a queue-private fence could
+        # never observe a competing producer, so defaulting one would
+        # read as protection while providing none). fence=None means
+        # fencing is explicitly OFF.
+        self.fence = fence
+        if epoch is not None:
+            self.epoch = epoch
+        else:
+            self.epoch = fence.epoch if fence is not None else 0
+        for p in range(n_partitions):
+            _G_FOLLOWERS.labels(partition=str(p)).set(
+                len(self.followers))
+
+    @staticmethod
+    def promote(follower_roots: list[str], n_partitions: int,
+                fence: Optional[Any] = None) -> FileOrderingQueue:
+        """Elect the best-replicated follower root into the leader
+        role: anti-entropy pulls any missing per-partition suffix
+        (and the highest mirrored commit) from every surviving peer —
+        a quorum-acked record lives on at least one of them — so the
+        promoted queue resumes at the TRUE replicated head, never a
+        laggard's. Pass the SHARED ``fence`` to depose the old
+        producer as part of promotion (``fence.advance()`` — without
+        it a presumed-dead producer that revives keeps writing). The
+        document plane's promotion protocol, queue-shaped."""
+        queues = [FileOrderingQueue(r, n_partitions, fsync=True)
+                  for r in follower_roots]
+        best = max(queues, key=lambda q: sum(q._counts))
+        for peer in queues:
+            if peer is best:
+                continue
+            for p in range(n_partitions):
+                if peer._counts[p] > best._counts[p]:
+                    for rec in peer.read(p, best._counts[p]):
+                        best.produce(p, rec.document_id, rec.payload)
+                best.commit(p, min(peer.committed(p),
+                                   best._counts[p] - 1))
+        if fence is not None:
+            # promotion IS the deposition: every stale-epoch producer
+            # and checkpoint commit is refused from here on
+            fence.advance()
+        return best
+
+    def produce(self, partition: int, document_id: str,
+                payload: dict) -> int:
+        # fence BEFORE the replicate gate (qoscheck:fence-before-
+        # fanout): a deposed producer must not extend any replica
+        if self.fence is not None:
+            self.fence.check(self.epoch, partition=partition)
+        offset = super().produce(partition, document_id, payload)
+        self._replicate_before_fanout(partition, offset)
+        return offset
+
+    def _replicate_before_fanout(self, partition: int,
+                                 offset: int) -> None:
+        """Quorum-durable before the consumer side may observe the
+        record — same contract (and the same ``repl.append_ack``
+        site) as the document plane's barrier."""
+        acked = 1  # the leader's own append
+        behind: list[FileOrderingQueue] = []
+        for f in self.followers:
+            fault = _SITE_REPL_ACK.fire(partition=partition,
+                                        offset=offset)
+            if fault is not None and _SITE_REPL_ACK.fire(
+                    partition=partition, offset=offset,
+                    retry=True) is not None:
+                behind.append(f)
+                continue
+            self._sync_follower(f, partition, offset)
+            acked += 1
+        for f in behind:
+            if acked >= self.quorum:
+                break
+            # the barrier BLOCKS on the laggard (see
+            # ReplicatedSequencerGroup.replicate_before_fanout)
+            self._sync_follower(f, partition, offset)
+            acked += 1
+
+    def _sync_follower(self, f: FileOrderingQueue, partition: int,
+                       upto_offset: int) -> None:
+        start = f._counts[partition]
+        for rec in self.read(partition, start):
+            if rec.offset > upto_offset:
+                break
+            f.produce(partition, rec.document_id, rec.payload)
+
+    def commit(self, partition: int, offset: int) -> None:
+        # the committed offset is CONSUMER authority — a deposed
+        # consumer moving it would silently skip records for the
+        # real one
+        if self.fence is not None:
+            self.fence.check(self.epoch, partition=partition,
+                             op="commit")
+        super().commit(partition, offset)
+        for f in self.followers:
+            f.commit(partition,
+                     min(offset, f._counts[partition] - 1))
+
+
+class ReplicatedCheckpointManager:
+    """CheckpointManager with the epoch fence on every commit: the
+    offset checkpoint is the consumer's claim to the partition, and
+    two consumers both advancing it is exactly the split-brain the
+    fence refuses. Same surface as :class:`CheckpointManager`."""
+
+    def __init__(self, queue: OrderingQueue, partition: int,
+                 fence: Any, epoch: int):
+        self._inner = CheckpointManager(queue, partition)
+        self._fence = fence
+        self._epoch = epoch
+
+    def starting(self, offset: int) -> None:
+        self._inner.starting(offset)
+
+    def completed(self, offset: int) -> None:
+        self._fence.check(self._epoch, op="checkpoint")
+        self._inner.completed(offset)
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,676 @@
+"""Replicated sequencer: op-log replication + leader failover — the
+ordering plane survives host loss with zero client-visible downtime.
+
+PR9 proved single-node crash-restart converges bit-identically, but a
+dead host still blacked out every document it ordered until an
+operator restarted it. This module replicates the sequencer's durable
+op log to N follower nodes behind an explicit ack barrier and elects
+a follower into the leader role when the leader's lease lapses — the
+contract "On Coordinating Collaborative Objects" (arXiv 1007.5093)
+frames: ONE total order per document, never re-issued, never forked,
+across the handoff.
+
+The three load-bearing pieces:
+
+- **The ack barrier** — PR9's fsync-before-fanout extends to
+  *fsync-AND-replicate-before-fanout*: ``ReplicatedOpLog`` makes the
+  local fsynced append, then blocks in
+  ``ReplicatedSequencerGroup.replicate_before_fanout`` until a QUORUM
+  of nodes holds the op durably, and only then does the pipeline fan
+  it out (scriptorium runs before the broadcaster, so the barrier
+  sits exactly where PR9's fsync sat). An op any client was ever told
+  about therefore survives the loss of any non-quorum subset of
+  nodes; an op the quorum never accepted was never fanned out, and
+  the submitting client still holds it pending (the PR9
+  reconnect/resubmit path replays it — no new client machinery).
+
+- **The epoch fence** — every leader writes under the epoch its lease
+  acquisition minted (``EpochFence.advance``). A deposed leader that
+  still *thinks* it holds the lease (the split-brain candidate: its
+  renewal was lost, or the lease service hiccuped) is refused at the
+  write seam: ``EpochFence.check`` raises ``FencedWriteError`` and
+  counts ``sequencer_fenced_writes_total`` BEFORE anything could fan
+  out, and every follower independently refuses stale epochs as the
+  backstop (fencing tokens: the RESOURCE checks the token, not the
+  leader's belief). The fluidlint rule ``qoscheck:fence-before-fanout``
+  pins the ordering statically.
+
+- **Promotion at exactly the replicated head** — failover flushes the
+  candidate's buffered (lagging) tail, anti-entropies any missing
+  suffix from every surviving peer (any fanned-out op is on at least
+  one surviving follower's contiguous prefix, because quorum heads
+  imply contiguous prefixes), then boots a fresh
+  ``ReplicatedLocalServer`` over the candidate's directory: the
+  orderer fast-forwards the sequencer to the log head and ticketing
+  resumes at exactly seq+1. Buffered ops still gapped after
+  anti-entropy were never quorum-durable — dropped; their submitters
+  resubmit.
+
+Layout: ``<root>/node-0`` is the initial leader's durable dir (a
+normal ``DocumentStorage`` tree per document); each follower keeps
+the SAME ``<node>/<doc>/ops.jsonl`` layout, which is what makes
+promotion "build a LocalServer over the follower's dir" instead of a
+data migration.
+
+Chaos seams (docs/ROBUSTNESS.md): ``repl.lag`` (a follower defers
+durability — replication lag), ``repl.append_ack`` (a follower's ack
+is lost / errors), ``repl.lease_expire`` (renewal dropped, or the
+lease service lapses the grant NOW — the split-brain trigger),
+``repl.promote`` (a transient election failure, retried).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+from ..obs import metrics as obs_metrics
+from ..protocol.messages import SequencedMessage
+from ..protocol.serialization import message_from_json, message_to_json
+from ..qos.faults import (
+    KIND_DEFER,
+    KIND_DROP,
+    KIND_ERROR,
+    PLANE,
+)
+from .local_orderer import LocalOrderer
+from .local_server import LocalServer
+from .storage import DocumentStorage, FileOpLog, atomic_write, \
+    read_jsonl_tolerant
+
+# chaos seams (one schedule drives the document plane and the
+# partitioned-queue counterpart in partitioning.py — shared names,
+# exactly like socket.frame_in/out across harnesses)
+_SITE_LAG = PLANE.site("repl.lag", (KIND_DEFER,))
+_SITE_ACK = PLANE.site("repl.append_ack", (KIND_DROP, KIND_ERROR))
+_SITE_LEASE = PLANE.site("repl.lease_expire", (KIND_DROP, KIND_ERROR))
+# error only: a deferred election would be indistinguishable from a
+# slightly-later failover call on the step clock — a kind the code
+# never acts on is exactly the vacuous vocabulary the sweep guard
+# exists to forbid
+_SITE_PROMOTE = PLANE.site("repl.promote", (KIND_ERROR,))
+
+_G_FOLLOWERS = obs_metrics.REGISTRY.gauge(
+    "repl_followers", "follower replicas behind the leader",
+    labelnames=("partition",))
+_G_LAG = obs_metrics.REGISTRY.gauge(
+    "repl_lag_ops",
+    "worst follower replication lag at the last append (ops)")
+_G_EPOCH = obs_metrics.REGISTRY.gauge(
+    "repl_epoch", "current sequencer leadership epoch")
+_C_FAILOVERS = obs_metrics.REGISTRY.counter(
+    "sequencer_failovers_total",
+    "follower promotions into the leader role")
+_C_FENCED = obs_metrics.REGISTRY.counter(
+    "sequencer_fenced_writes_total",
+    "writes refused by the epoch fence (deposed-leader attempts)")
+
+
+class FencedWriteError(RuntimeError):
+    """A write carried a stale leadership epoch: the writer was
+    deposed. Refusing it here (BEFORE fan-out) is what makes a
+    split-brain candidate harmless — the op was never sequenced as
+    far as any client can observe, so the submitter resubmits it to
+    the real leader."""
+
+
+class LeaseHeldError(RuntimeError):
+    """Acquisition attempted while a live (unexpired) lease is held
+    by another node."""
+
+
+class EpochFence:
+    """The monotone leadership epoch and THE check every replicated
+    write makes before anything can fan out. ``advance()`` is called
+    only by lease acquisition — one epoch per leadership term."""
+
+    def __init__(self, epoch: int = 0):
+        self.epoch = epoch
+
+    def advance(self) -> int:
+        self.epoch += 1
+        _G_EPOCH.set(self.epoch)
+        return self.epoch
+
+    def check(self, epoch: int, **context) -> None:
+        if epoch != self.epoch:
+            _C_FENCED.inc()
+            raise FencedWriteError(
+                f"epoch fence: write under epoch {epoch} refused, "
+                f"current epoch is {self.epoch} ({context}) — the "
+                "writer was deposed; the op stays with its submitter "
+                "and resubmits to the current leader")
+
+
+class SequencerLease:
+    """The lease seam: leadership is a TTL'd grant renewed on the
+    replication heartbeat. Clock-injectable (the chaos harness drives
+    it on the step clock), so lease expiry — and therefore failover
+    timing — is deterministic. Acquisition advances the epoch fence;
+    renewal consults the ``repl.lease_expire`` chaos site, whose
+    faults model the two real-world lease failure shapes: a renewal
+    lost in transit (``drop`` — the TTL keeps running) and the lease
+    service lapsing the grant NOW without telling the holder
+    (``error`` — the split-brain trigger)."""
+
+    def __init__(self, fence: EpochFence, ttl: float = 0.3,
+                 clock=None):
+        self.fence = fence
+        self.ttl = ttl
+        self.clock = clock or time.monotonic
+        self.holder: Optional[str] = None
+        self.expires_at = float("-inf")
+
+    @property
+    def epoch(self) -> int:
+        return self.fence.epoch
+
+    def expired(self) -> bool:
+        return self.clock() >= self.expires_at
+
+    def acquire(self, node_id: str) -> int:
+        if self.holder not in (None, node_id) and not self.expired():
+            raise LeaseHeldError(
+                f"lease held by {self.holder!r} for another "
+                f"{self.expires_at - self.clock():.3f}s")
+        self.holder = node_id
+        self.expires_at = self.clock() + self.ttl
+        return self.fence.advance()
+
+    def renew(self, node_id: str, epoch: int) -> bool:
+        if node_id != self.holder or epoch != self.fence.epoch:
+            return False  # deposed caller: the grant moved on
+        fault = _SITE_LEASE.fire(holder=node_id)
+        if fault == KIND_DROP:
+            return False  # renewal lost in transit; TTL keeps running
+        if fault == KIND_ERROR:
+            # lease-service hiccup: the grant lapses NOW and the
+            # holder is NOT told — it keeps writing until the epoch
+            # fence refuses it (the split-brain candidate the
+            # deposed-race chaos mode exercises)
+            self.expires_at = self.clock()
+            return False
+        self.expires_at = self.clock() + self.ttl
+        return True
+
+    def force_expire(self, reason: str = "forced") -> None:
+        """Harness-driven lapse (the deposed-race schedule), recorded
+        through the plane like any crash-time forced state."""
+        _SITE_LEASE.force(KIND_ERROR, reason=reason)
+        self.expires_at = self.clock()
+
+
+class FollowerReplica:
+    """One follower sequencer node: a durable, per-document,
+    contiguous copy of the leader's op log, in EXACTLY the layout a
+    ``LocalServer`` durable dir uses (``<root>/<doc>/ops.jsonl``) —
+    so promotion is "boot a server over this directory", not a data
+    migration. Appends fsync before acking (the follower's half of
+    the ack barrier); a deferred (lagging) append is buffered
+    in-memory and acked only once durable."""
+
+    def __init__(self, root: str, node_id: str):
+        self.root = root
+        self.node_id = node_id
+        os.makedirs(root, exist_ok=True)
+        self.max_epoch_seen = 0
+        self._heads: dict[str, int] = {}
+        self._fhs: dict[str, Any] = {}
+        self._lag: dict[str, list[SequencedMessage]] = {}
+        # resume replicated heads from disk (a follower surviving its
+        # own restart) — torn tails tolerated exactly like the
+        # leader's log: the torn op never acked, so discarding it is
+        # exact
+        for doc in sorted(os.listdir(root)):
+            path = self._log_path(doc)
+            if not os.path.isfile(path):
+                continue
+            rows, torn = read_jsonl_tolerant(path, "repl")
+            if torn:
+                atomic_write(path, "".join(
+                    json.dumps(r) + "\n" for r in rows))
+            if rows:
+                self._heads[doc] = rows[-1]["sequenceNumber"]
+
+    def _log_path(self, doc: str) -> str:
+        return os.path.join(self.root, doc, "ops.jsonl")
+
+    def _fh(self, doc: str):
+        fh = self._fhs.get(doc)
+        if fh is None:
+            os.makedirs(os.path.join(self.root, doc), exist_ok=True)
+            fh = open(self._log_path(doc), "a")
+            self._fhs[doc] = fh
+        return fh
+
+    # -- state ----------------------------------------------------------
+
+    def documents(self) -> list[str]:
+        return sorted(set(self._heads) | set(self._lag))
+
+    def head(self, doc: str) -> int:
+        """Last DURABLY replicated seq for ``doc`` (0 = none)."""
+        return self._heads.get(doc, 0)
+
+    def total_head(self) -> int:
+        return sum(self._heads.values())
+
+    def lag_depth(self) -> int:
+        return sum(len(v) for v in self._lag.values())
+
+    # -- the replication stream ----------------------------------------
+
+    def _check_epoch(self, epoch: int, doc: str) -> None:
+        if epoch < self.max_epoch_seen:
+            _C_FENCED.inc()
+            raise FencedWriteError(
+                f"follower {self.node_id}: append under epoch "
+                f"{epoch} refused (seen {self.max_epoch_seen}, "
+                f"doc {doc!r}) — fencing-token backstop")
+        self.max_epoch_seen = epoch
+
+    def note_epoch(self, epoch: int) -> None:
+        """A new leader's first contact: stale-epoch writes from the
+        deposed leader are refused from here on."""
+        self.max_epoch_seen = max(self.max_epoch_seen, epoch)
+
+    def buffer_lag(self, doc: str, epoch: int,
+                   msg: SequencedMessage) -> None:
+        """Replication lag: the op arrived but is NOT yet durable —
+        no ack. ``flush_lag`` makes the contiguous prefix durable."""
+        self._check_epoch(epoch, doc)
+        self._lag.setdefault(doc, []).append(msg)
+
+    def append_durable(self, doc: str, epoch: int,
+                       msg: SequencedMessage) -> None:
+        self._check_epoch(epoch, doc)
+        self._append_raw(doc, msg)
+
+    def _append_raw(self, doc: str, msg: SequencedMessage) -> None:
+        assert msg.sequence_number == self.head(doc) + 1, (
+            f"follower {self.node_id} log must stay contiguous: "
+            f"append seq {msg.sequence_number} onto head "
+            f"{self.head(doc)} (doc {doc!r})")
+        fh = self._fh(doc)
+        fh.write(json.dumps(message_to_json(msg)) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())  # durable BEFORE the ack counts
+        self._heads[doc] = msg.sequence_number
+
+    def flush_lag(self, doc: Optional[str] = None) -> int:
+        """Durably apply the buffered tail's CONTIGUOUS prefix;
+        anything gapped (an earlier op was dropped in transit) stays
+        buffered until catch-up supplies the middle. Returns ops
+        applied."""
+        applied = 0
+        for d in ([doc] if doc is not None else list(self._lag)):
+            pending = sorted(self._lag.get(d, []),
+                             key=lambda m: m.sequence_number)
+            keep: list[SequencedMessage] = []
+            for msg in pending:
+                if msg.sequence_number <= self.head(d):
+                    continue  # catch-up already supplied it
+                if msg.sequence_number == self.head(d) + 1:
+                    self._append_raw(d, msg)
+                    applied += 1
+                else:
+                    keep.append(msg)
+            if keep:
+                self._lag[d] = keep
+            else:
+                self._lag.pop(d, None)
+        return applied
+
+    def drop_lag(self) -> int:
+        """Discard buffered ops still gapped after anti-entropy: no
+        surviving node holds the middle, so they were never
+        quorum-durable — never fanned out — and their submitters
+        still hold them pending. Returns ops dropped."""
+        dropped = self.lag_depth()
+        self._lag.clear()
+        return dropped
+
+    def sync_from(self, doc: str, msgs: list[SequencedMessage]) -> int:
+        """Anti-entropy: apply a peer/leader-supplied range (ops at or
+        below our head are skipped — at-least-once safe)."""
+        applied = 0
+        for msg in msgs:
+            if msg.sequence_number <= self.head(doc):
+                continue
+            self._append_raw(doc, msg)
+            applied += 1
+        return applied
+
+    def read_log(self, doc: str,
+                 from_seq: int = 0) -> list[SequencedMessage]:
+        """Ops with seq > from_seq from the durable replica log."""
+        path = self._log_path(doc)
+        if not os.path.isfile(path):
+            return []
+        rows, _ = read_jsonl_tolerant(path, "repl")
+        return [message_from_json(r) for r in rows
+                if r["sequenceNumber"] > from_seq]
+
+    def close(self) -> None:
+        for fh in self._fhs.values():
+            fh.close()
+        self._fhs.clear()
+
+
+class ReplicatedOpLog(FileOpLog):
+    """The leader's per-document op log under the extended ack
+    barrier: fence check, local fsynced append (PR9's barrier), then
+    BLOCK until a quorum of followers holds the op durably — all
+    before ``OpLog.append`` returns to scriptorium, which runs before
+    the broadcaster, so nothing fans out un-replicated."""
+
+    def __init__(self, path: str, group: "ReplicatedSequencerGroup",
+                 document_id: str, epoch: int):
+        self._group = group
+        self._doc = document_id
+        self._epoch = epoch
+        super().__init__(path)
+
+    def _persist_append(self, msg: SequencedMessage) -> None:
+        try:
+            self._group.fence.check(self._epoch, doc=self._doc,
+                                    op="append")
+        except FencedWriteError:
+            # OpLog.append adds to the in-memory list BEFORE
+            # persisting: the refused op must not linger there either,
+            # or a deposed leader's read path would serve an op the
+            # quorum never accepted
+            self._ops.pop()
+            raise
+        super()._persist_append(msg)  # local fsync (the PR9 barrier)
+        self._group.replicate_before_fanout(
+            self._doc, self._epoch, msg, self)
+
+    def truncate_below(self, seq: int) -> int:
+        # summary truncation must never outrun a laggard: this log is
+        # every follower's catch-up source, and dropping records a
+        # follower still needs would turn its next catch-up into an
+        # unfillable gap
+        return super().truncate_below(
+            min(seq, self._group.replication_floor(self._doc)))
+
+
+class ReplicatedDocumentStorage(DocumentStorage):
+    """DocumentStorage whose op log is a :class:`ReplicatedOpLog`
+    (summaries and checkpoints stay node-local: the replicated log is
+    the recovery truth, and a promoted follower rebuilds everything
+    else from it)."""
+
+    def __init__(self, root: str, group: "ReplicatedSequencerGroup",
+                 document_id: str, epoch: int):
+        self._group = group
+        self._document_id = document_id
+        self._epoch = epoch
+        super().__init__(root)
+
+    def _make_op_log(self, path: str) -> FileOpLog:
+        return ReplicatedOpLog(path, self._group,
+                               self._document_id, self._epoch)
+
+
+class ReplicatedLocalServer(LocalServer):
+    """The LocalServer surface over the replicated plane: per-document
+    orderers write through :class:`ReplicatedOpLog`, submits are
+    fence-checked BEFORE ticketing (a deposed leader must not even
+    consume sequence numbers), and the read path serves only
+    quorum-COMMITTED ops — the window where an op is leader-durable
+    but not yet quorum-durable is never client-visible."""
+
+    def __init__(self, group: "ReplicatedSequencerGroup",
+                 durable_dir: str, **kwargs):
+        super().__init__(durable_dir=durable_dir, **kwargs)
+        self.group = group
+        self.epoch = group.fence.epoch
+
+    def _make_storage(self, document_id: str):
+        return ReplicatedDocumentStorage(
+            os.path.join(self.durable_dir, document_id),
+            self.group, document_id, self.epoch)
+
+    def _make_orderer(self, document_id: str) -> LocalOrderer:
+        return LocalOrderer(
+            document_id, storage=self._make_storage(document_id),
+            storage_breaker=self.storage_breaker,
+            checkpoint_every=self.checkpoint_every,
+            write_fence=self._fence_check_for(document_id),
+        )
+
+    def _fence_check_for(self, document_id: str):
+        def check(op: str = "write") -> None:
+            self.group.fence.check(self.epoch, doc=document_id,
+                                   op=op)
+        return check
+
+    def read_ops(self, document_id: str, from_seq: int,
+                 to_seq: Optional[int] = None):
+        # a deposed server must not serve reads either: its in-memory
+        # state may disagree with the order the new leader is minting
+        self.group.fence.check(self.epoch, doc=document_id, op="read")
+        committed = self.group.committed(document_id)
+        to = committed if to_seq is None else min(to_seq, committed)
+        return super().read_ops(document_id, from_seq, to)
+
+
+class ReplicatedSequencerGroup:
+    """Leader + N follower sequencer nodes for one ordering scope.
+
+    The group owns the lease, the epoch fence, the follower set and
+    the committed watermark; the current leader's
+    :class:`ReplicatedLocalServer` is ``group.server`` (callers front
+    it with an AlfredServer exactly like a plain LocalServer — after
+    a failover they front the NEW ``group.server`` and clients ride
+    the PR9 reconnect/resubmit path through the handoff)."""
+
+    def __init__(self, root: str, n_followers: int = 2,
+                 quorum: Optional[int] = None, clock=None,
+                 lease_ttl: float = 0.3, scope: str = "docs",
+                 server_kwargs: Optional[dict] = None):
+        if n_followers < 1:
+            raise ValueError(
+                "a replicated sequencer needs at least one follower "
+                "(n_followers >= 1), or host loss loses acked ops")
+        self.root = root
+        self.scope = scope
+        self.clock = clock or time.monotonic
+        self.fence = EpochFence()
+        self.lease = SequencerLease(self.fence, ttl=lease_ttl,
+                                    clock=self.clock)
+        self.followers = [
+            FollowerReplica(os.path.join(root, f"node-{i}"),
+                            f"node-{i}")
+            for i in range(1, n_followers + 1)
+        ]
+        # quorum over ALL nodes (leader included); default = a strict
+        # majority of the initial group ((total // 2) + 1 — for even
+        # group sizes too: 4 nodes need 3, or losing a minority could
+        # lose a client-acked op), floored at 2 so at least one
+        # follower always holds every fanned-out op
+        self.quorum = quorum if quorum is not None else max(
+            2, (n_followers + 1) // 2 + 1)
+        if self.quorum > 1 + n_followers:
+            raise ValueError(
+                f"quorum {self.quorum} unsatisfiable with "
+                f"{n_followers} followers")
+        self.server_kwargs = dict(server_kwargs or {})
+        self._committed: dict[str, int] = {}
+        self.max_lag_observed = 0
+        self.leader_id = "node-0"
+        self.epoch = self.lease.acquire(self.leader_id)
+        self.server = self._build_server(
+            os.path.join(root, "node-0"))
+        _G_FOLLOWERS.labels(partition=self.scope).set(
+            len(self.followers))
+
+    def _build_server(self, durable_dir: str) -> ReplicatedLocalServer:
+        return ReplicatedLocalServer(self, durable_dir,
+                                     **self.server_kwargs)
+
+    # -- committed watermark -------------------------------------------
+
+    def committed(self, doc: str) -> int:
+        """Highest quorum-durable seq for ``doc`` — the only ops the
+        read path may serve (Raft's commitIndex shape)."""
+        return self._committed.get(doc, 0)
+
+    def replication_floor(self, doc: str) -> int:
+        """Lowest follower head: truncation must stay below nothing a
+        laggard still needs from the leader's log."""
+        return min(f.head(doc) for f in self.followers) \
+            if self.followers else self.committed(doc)
+
+    # -- the ack barrier ------------------------------------------------
+
+    def replicate_before_fanout(self, doc: str, epoch: int,
+                                msg: SequencedMessage,
+                                source_log) -> None:
+        """Block until ``msg`` is durable on a quorum. Callers check
+        the epoch fence FIRST (qoscheck:fence-before-fanout pins the
+        ordering statically). Follower faults are absorbed — the
+        quorum is the contract, not any single ack: a lagging or
+        unreachable follower simply doesn't count, and when the
+        prompt acks fall short the barrier force-syncs laggards in
+        deterministic order (the leader genuinely WAITS on its
+        quorum, exactly what an ack barrier means)."""
+        seq = msg.sequence_number
+        acked = 1  # the leader's own fsynced append
+        for f in self.followers:
+            if self._offer(f, doc, epoch, msg, source_log):
+                acked += 1
+        # leadership heartbeat piggybacks on replication traffic
+        self.lease.renew(self.leader_id, epoch)
+        if acked < self.quorum:
+            for f in self.followers:
+                if acked >= self.quorum:
+                    break
+                if f.head(doc) >= seq:
+                    continue
+                self._force_sync(f, doc, epoch, msg, source_log)
+                acked += 1
+        heads = sorted([seq] + [f.head(doc) for f in self.followers],
+                       reverse=True)
+        self._committed[doc] = max(self.committed(doc),
+                                   heads[self.quorum - 1])
+        lag = max((seq - f.head(doc) for f in self.followers),
+                  default=0)
+        _G_LAG.set(lag)
+        self.max_lag_observed = max(self.max_lag_observed, lag)
+
+    def _offer(self, f: FollowerReplica, doc: str, epoch: int,
+               msg: SequencedMessage, source_log) -> bool:
+        """One replication attempt to one follower; True = durable
+        ack. ``defer`` buffers (replication lag); a dropped/erroring
+        ack is retried once (the broker-append idiom), then the
+        follower just misses this round — catch-up repairs it on the
+        next offer or at promotion."""
+        seq = msg.sequence_number
+        if _SITE_LAG.fire(follower=f.node_id, doc=doc,
+                          seq=seq) == KIND_DEFER:
+            f.buffer_lag(doc, epoch, msg)
+            return False
+        fault = _SITE_ACK.fire(follower=f.node_id, doc=doc, seq=seq)
+        if fault is not None:
+            fault = _SITE_ACK.fire(follower=f.node_id, doc=doc,
+                                   seq=seq, retry=True)
+            if fault is not None:
+                return False
+        self._catch_up(f, doc, seq - 1, source_log)
+        f.append_durable(doc, epoch, msg)
+        return True
+
+    def _catch_up(self, f: FollowerReplica, doc: str, upto: int,
+                  source_log) -> None:
+        f.flush_lag(doc)
+        if f.head(doc) < upto:
+            f.sync_from(doc, source_log.read(f.head(doc), upto))
+
+    def _force_sync(self, f: FollowerReplica, doc: str, epoch: int,
+                    msg: SequencedMessage, source_log) -> None:
+        """The blocking path: quorum shortfall makes the leader WAIT
+        on this follower — flush its buffer, supply any missing
+        middle from the leader's log, land the op. No chaos sites
+        fire here: the faults already fired (and were recorded) on
+        the offer; this is the barrier waiting them out."""
+        self._catch_up(f, doc, msg.sequence_number - 1, source_log)
+        if f.head(doc) >= msg.sequence_number:
+            return  # the flushed buffer already contained it
+        f.append_durable(doc, epoch, msg)
+
+    # -- failover -------------------------------------------------------
+
+    def kill_leader(self):
+        """Host loss: the leader process is simply gone — nothing
+        graceful happens; the lease stops being renewed and lapses on
+        its TTL. Returns the dead server object (harnesses keep it to
+        model the deposed-leader race)."""
+        dead = self.server
+        self.server = None
+        return dead
+
+    def laggiest_follower(self) -> FollowerReplica:
+        return min(self.followers, key=lambda f: f.total_head())
+
+    def failover(self, candidate: Optional[FollowerReplica] = None
+                 ) -> ReplicatedLocalServer:
+        """Elect ``candidate`` (default: the best-replicated
+        follower) into the leader role. Refuses while a live lease is
+        held — failover is lease-driven, never a second writer."""
+        if not self.lease.expired():
+            raise LeaseHeldError(
+                f"lease held by {self.lease.holder!r}; failover "
+                "requires the lease to lapse first")
+        if not self.followers:
+            raise RuntimeError("no followers left to promote")
+        if candidate is None:
+            # max() keeps the FIRST maximum: deterministic low-index
+            # tie-break
+            candidate = max(self.followers,
+                            key=lambda f: f.total_head())
+        fault = _SITE_PROMOTE.fire(node=candidate.node_id)
+        if fault == KIND_ERROR:
+            # transient election failure: the retry is exact (nothing
+            # was promoted); a second injected fault is absorbed the
+            # same way — promotion is idempotent until acquire()
+            _SITE_PROMOTE.fire(node=candidate.node_id, retry=True)
+        return self._promote(candidate)
+
+    def _promote(self, candidate: FollowerReplica
+                 ) -> ReplicatedLocalServer:
+        # 1) the candidate's own received-but-buffered tail
+        candidate.flush_lag()
+        # 2) anti-entropy from every surviving peer: any fanned-out op
+        # is durable on >= quorum-1 followers, so at least one
+        # surviving peer holds it in its contiguous prefix
+        for peer in self.followers:
+            if peer is candidate:
+                continue
+            for doc in peer.documents():
+                if peer.head(doc) > candidate.head(doc):
+                    candidate.sync_from(
+                        doc, peer.read_log(doc, candidate.head(doc)))
+        candidate.flush_lag()
+        candidate.drop_lag()
+        # 3) mint the new epoch and fence everyone else out
+        self.epoch = self.lease.acquire(candidate.node_id)
+        self.leader_id = candidate.node_id
+        self.followers = [f for f in self.followers
+                          if f is not candidate]
+        for f in self.followers:
+            f.note_epoch(self.epoch)
+        self.quorum = min(self.quorum, 1 + len(self.followers))
+        self._committed = {doc: candidate.head(doc)
+                           for doc in candidate.documents()}
+        # 4) the follower's dir BECOMES the leader's durable dir: the
+        # orderer boot path fast-forwards each sequencer to its log
+        # head, so ticketing resumes at exactly the replicated head
+        candidate.close()
+        self.server = self._build_server(candidate.root)
+        _C_FAILOVERS.inc()
+        _G_FOLLOWERS.labels(partition=self.scope).set(
+            len(self.followers))
+        return self.server

@@ -203,6 +203,17 @@ class DocumentSequencer:
         _SYSTEM_MSGS.inc()
         return self._stamp_system(msg_type, contents, self._next_seq())
 
+    def fast_forward(self, seq: int) -> None:
+        """O(1) stream-position resume (restart fast-forward, follower
+        promotion): equivalent to sequencing ``seq - sequence_number``
+        NO_OPs — only the final seq and one msn recomputation are
+        observable, and neither allocates per-op messages. A promoted
+        follower with a full replicated log used to pay O(log) here."""
+        if seq <= self.sequence_number:
+            return
+        self.sequence_number = seq
+        self._compute_msn()
+
     # ------------------------------------------------------------------
     # checkpoint / resume (deli/checkpointContext.ts)
 

@@ -140,6 +140,29 @@ def read_jsonl_tolerant(path: str, label: str) -> tuple[list, bool]:
     return rows, False
 
 
+def read_offset_tolerant(path: str, label: str = "offset") -> int:
+    """Parse a committed-offset file, degrading LOUDLY to -1 (no
+    commit) on garbage. With commits routed through ``atomic_write``
+    a torn offset is unreachable going forward, but a pre-barrier
+    data dir can still hold one — and re-consuming from scratch is
+    exactly what at-least-once delivery absorbs, while a crash here
+    would take the partition down for an operator restart."""
+    with open(path) as f:
+        raw = f.read().strip()
+    try:
+        return int(raw or -1)
+    except ValueError:
+        _M_TORN.labels(file=label).inc()
+        print(
+            f"storage: committed-offset file {path!r} is "
+            f"torn/unparseable ({raw[:40]!r}); treating as no commit "
+            "— the consumer re-reads from the log head and the "
+            "at-least-once dedupe absorbs the replay",
+            file=sys.stderr,
+        )
+        return -1
+
+
 def _canonical(obj: Any) -> bytes:
     return json.dumps(obj, sort_keys=True, separators=(",", ":")
                       ).encode("utf-8")
@@ -364,7 +387,8 @@ class DocumentStorage:
         self.trees = SummaryTreeStore(
             FileContentStore(os.path.join(root, "store"))
         )
-        self.op_log = FileOpLog(os.path.join(root, "ops.jsonl"))
+        self.op_log = self._make_op_log(
+            os.path.join(root, "ops.jsonl"))
         self._versions_path = os.path.join(root, "versions.jsonl")
         self.versions: list[SummaryVersion] = []
         if os.path.exists(self._versions_path):
@@ -389,6 +413,12 @@ class DocumentStorage:
             os.remove(self._checkpoint_path + ".tmp")
         except OSError:
             pass
+
+    def _make_op_log(self, path: str) -> FileOpLog:
+        """Op-log factory hook: the replicated sequencer
+        (service/replication.py) swaps in a ReplicatedOpLog whose
+        append blocks on the replication quorum."""
+        return FileOpLog(path)
 
     # summaries
     def write_summary(self, sequence_number: int,

@@ -58,7 +58,9 @@ from ..loader.container import Container
 from ..obs import metrics as obs_metrics
 from ..protocol.constants import batch_flag
 from ..protocol.messages import (
+    ClientDetail,
     DocumentMessage,
+    MessageType,
     Nack,
     NackErrorType,
     SequencedMessage,
@@ -66,10 +68,12 @@ from ..protocol.messages import (
 from ..protocol.serialization import decode_contents, message_from_json
 from ..qos import CircuitBreaker
 from ..qos.faults import (
+    KIND_DEFER,
     KIND_DELAY,
     KIND_DISCONNECT,
     KIND_DROP,
     KIND_DUPLICATE,
+    KIND_ERROR,
     KIND_NACK,
     KIND_REORDER,
     KIND_TORN_WRITE,
@@ -423,15 +427,20 @@ class ChaosHarness:
     SIDECAR_MAX_CAPACITY = 32
     SIDECAR_POOL_CAPACITY = 128
 
-    def __init__(self, durable_dir: str, checkpoint_every: int = 5):
+    def __init__(self, durable_dir: str, checkpoint_every: int = 5,
+                 replicated: bool = False, n_followers: int = 2):
         self.durable_dir = durable_dir
         self.checkpoint_every = checkpoint_every
+        self.replicated = replicated
+        self.n_followers = n_followers
         self.clock = ManualClock()
         self.services: dict[str, ChaosDocumentService] = {}
         self._transports: dict[str, ChaosTransport] = {}
         self.server: Optional[AlfredServer] = None
         self.sidecar = None
+        self.group = None  # ReplicatedSequencerGroup when replicated
         self.crashes = 0
+        self.failovers = 0
         self._boot()
 
     def _boot(self) -> None:
@@ -439,14 +448,30 @@ class ChaosHarness:
         # (a failing disk degrades durability, never availability —
         # the op log is the recovery path), on the harness clock so
         # open->half-open->close is step-deterministic
-        self.server = AlfredServer(LocalServer(
-            durable_dir=self.durable_dir,
-            checkpoint_every=self.checkpoint_every,
-            storage_breaker=CircuitBreaker(
-                "chaos-checkpoint", failure_threshold=3,
-                reset_timeout_s=0.2, clock=self.clock,
-            ),
-        ))
+        breaker = CircuitBreaker(
+            "chaos-checkpoint", failure_threshold=3,
+            reset_timeout_s=0.2, clock=self.clock,
+        )
+        if self.replicated:
+            from ..service.replication import ReplicatedSequencerGroup
+
+            if self.group is None:
+                self.group = ReplicatedSequencerGroup(
+                    self.durable_dir, n_followers=self.n_followers,
+                    clock=self.clock, lease_ttl=0.3,
+                    server_kwargs=dict(
+                        checkpoint_every=self.checkpoint_every,
+                        storage_breaker=breaker,
+                    ),
+                )
+            local = self.group.server
+        else:
+            local = LocalServer(
+                durable_dir=self.durable_dir,
+                checkpoint_every=self.checkpoint_every,
+                storage_breaker=breaker,
+            )
+        self.server = AlfredServer(local)
         self._build_sidecar()
 
     def _build_sidecar(self) -> None:
@@ -553,11 +578,7 @@ class ChaosHarness:
         must not report (or count toward coverage) a tear the barrier
         refused.
         """
-        for transport in self._transports.values():
-            transport.abandon()
-        for svc in self.services.values():
-            if svc.connection is not None:
-                svc.connection.open = False
+        self._abandon_all()
         self.server = None
         self.crashes += 1
         applied = False
@@ -569,6 +590,61 @@ class ChaosHarness:
         for msg in self.server.local.read_ops(DOC_BETA, 0):
             self.sidecar.ingest(DOC_BETA, msg)
         return applied
+
+    def _abandon_all(self) -> None:
+        for transport in self._transports.values():
+            transport.abandon()
+        for svc in self.services.values():
+            if svc.connection is not None:
+                svc.connection.open = False
+
+    # -- leader failover (the replicated plane) -------------------------
+
+    def kill_leader(self, mode: str = "clean") -> None:
+        """Host loss on the replicated plane: the leader dies with no
+        goodbyes (transports abandoned, nothing sequences a leave),
+        the lease lapses on its TTL, a follower is promoted at
+        exactly the replicated head, and clients ride the PR9
+        reconnect/resubmit path onto the new leader — no new client
+        machinery, which is the point. ``mode="under_lag"`` promotes
+        the LAGGIEST follower (flush + anti-entropy must still land
+        it on the exact head)."""
+        assert self.group is not None, "kill_leader needs replicated="
+        self._abandon_all()
+        self.server = None
+        self.group.kill_leader()
+        # the host is gone; nobody renews: walk the step clock past
+        # the TTL — the lease seam is what converts host loss into an
+        # election instead of a hung lock
+        self.clock.t += self.group.lease.ttl + 0.01
+        candidate = (self.group.laggiest_follower()
+                     if mode == "under_lag" else None)
+        self.group.failover(candidate=candidate)
+        self.failovers += 1
+        self._swap_to_new_leader()
+
+    def begin_depose(self) -> None:
+        """The split-brain candidate: the lease service lapses the
+        grant while the leader is ALIVE and serving; a follower is
+        promoted. The old leader keeps its transports until
+        ``complete_leader_swap`` — every write driven through them in
+        between must be refused by the epoch fence."""
+        assert self.group is not None
+        self.group.lease.force_expire(reason="deposed_race")
+        self.group.failover()
+        self.failovers += 1
+
+    def complete_leader_swap(self) -> None:
+        self._abandon_all()
+        self._swap_to_new_leader()
+
+    def _swap_to_new_leader(self) -> None:
+        self.server = AlfredServer(self.group.server)
+        self._build_sidecar()
+        # the sidecar rebuilds from the REPLICATED op log, exactly
+        # like the crash path rebuilds from the durable one
+        for msg in self.server.local.read_ops(DOC_BETA, 0):
+            self.sidecar.ingest(DOC_BETA, msg)
 
     def _apply_tear(self, tear: str,
                     containers: list[Container]) -> bool:
@@ -658,6 +734,14 @@ class ChaosReport:
     beta_text: str = ""
     sidecar_tier: str = ""
     pool_watermarks: dict = field(default_factory=dict)
+    # replicated-plane runs (run_chaos_failover)
+    failovers: int = 0
+    kill_mode: Optional[str] = None
+    fenced_writes: int = 0
+    repl_lag_max: int = 0
+    # the broker coverage leg (exactly-once through the partitioned
+    # queue seams, every run)
+    broker_ops: int = 0
 
     def deterministic_fields(self) -> dict:
         """Everything that must be bit-equal for the same seed (the
@@ -675,6 +759,11 @@ class ChaosReport:
             "beta_text": self.beta_text,
             "sidecar_tier": self.sidecar_tier,
             "pool_watermarks": dict(self.pool_watermarks),
+            "failovers": self.failovers,
+            "kill_mode": self.kill_mode,
+            "fenced_writes": self.fenced_writes,
+            "repl_lag_max": self.repl_lag_max,
+            "broker_ops": self.broker_ops,
         }
 
 
@@ -696,6 +785,29 @@ def crash_plan(seed: int, n_steps: int) -> tuple[Optional[int],
             "oplog_tail"][(seed // 2) % 4]
     step = n_steps // 2 + (seed % 5)
     return step, tear
+
+
+KILL_MODES = ("clean", "mid_batch", "under_lag", "deposed_race")
+
+
+def failover_plan(seed: int, n_steps: int) -> tuple[Optional[int],
+                                                    Optional[str]]:
+    """(kill step, kill mode) as a PURE function of the seed for the
+    replicated-plane differential: three of every four seeds kill the
+    leader (cycling the enumerated modes — clean host loss, kill
+    MID-BATCH between one writer's flush and the next, promotion of a
+    follower with real replication LAG, and the deposed-leader
+    split-brain race), the fourth runs the armed schedule over the
+    replicated plane with no kill (replication must also survive
+    plain chaos). The mode cycles with (seed%4 + seed//4), so any
+    seed range [0, 8k) provably covers every mode plus the no-kill
+    case (deposed_race first appears at seed 6 — a 4-seed sweep is
+    NOT enough)."""
+    if seed % 4 == 3:
+        return None, None
+    mode = KILL_MODES[(seed % 4 + seed // 4) % 4]
+    step = n_steps // 2 + (seed % 5)
+    return step, mode
 
 
 _ALPHA_TAGS = ("A", "B", "C")
@@ -728,7 +840,8 @@ def _region_edit(container: Container, tag: str, serial: int,
 def run_chaos(seed: int, faults: bool = True,
               n_steps: int = 40, workload_seed: int = 1234,
               durable_dir: Optional[str] = None,
-              sites: Optional[list[str]] = None) -> ChaosReport:
+              sites: Optional[list[str]] = None,
+              replicated: bool = False) -> ChaosReport:
     """One chaos run: scripted workload, seeded schedule, optional
     crash-restart, quiesce, convergence checks. ``faults=False`` is
     the fault-free oracle (same workload, nothing armed, no crash).
@@ -742,7 +855,8 @@ def run_chaos(seed: int, faults: bool = True,
         durable_dir = tempfile.mkdtemp(prefix="fftpu-chaos-")
     try:
         _run_chaos_into(report, seed, faults, n_steps,
-                        workload_seed, durable_dir, sites)
+                        workload_seed, durable_dir, sites,
+                        replicated=replicated)
     finally:
         if PLANE.armed:
             PLANE.disarm()
@@ -753,19 +867,49 @@ def run_chaos(seed: int, faults: bool = True,
         k: int(v) for k, v in sorted(delta.items())
         if k.startswith("chaos_injected_total")
     }
+    report.fenced_writes = int(delta.get(
+        "sequencer_fenced_writes_total", 0))
     report.converged = not report.failures
     return report
+
+
+def run_chaos_failover(seed: int, faults: bool = True,
+                       n_steps: int = 40,
+                       workload_seed: int = 1234,
+                       durable_dir: Optional[str] = None,
+                       sites: Optional[list[str]] = None
+                       ) -> ChaosReport:
+    """THE kill-the-leader differential entry point: the same
+    scripted workload over the REPLICATED sequencer plane, with
+    ``failover_plan(seed)`` killing the leader mid-run (mid-batch,
+    under replication lag, or as a deposed-leader race — see
+    KILL_MODES). ``faults=False`` is the replicated fault-free
+    oracle; replication is TRANSPARENT, so its converged state must
+    also equal the plain-plane oracle's (pinned in test_chaos.py).
+    A failing seed reproduces alone: ``run_chaos_failover(seed)``."""
+    return run_chaos(seed, faults=faults, n_steps=n_steps,
+                     workload_seed=workload_seed,
+                     durable_dir=durable_dir, sites=sites,
+                     replicated=True)
 
 
 def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
                     n_steps: int, workload_seed: int,
                     durable_dir: str,
-                    sites: Optional[list[str]]) -> None:
-    harness = ChaosHarness(durable_dir)
+                    sites: Optional[list[str]],
+                    replicated: bool = False) -> None:
+    harness = ChaosHarness(durable_dir, replicated=replicated)
     wl = random.Random(workload_seed)  # the SAME script for any seed
-    crash_step, tear = crash_plan(seed, n_steps) if faults \
-        else (None, None)
+    if replicated:
+        crash_step, tear = None, None
+        kill_step, kill_mode = failover_plan(seed, n_steps) \
+            if faults else (None, None)
+    else:
+        crash_step, tear = crash_plan(seed, n_steps) if faults \
+            else (None, None)
+        kill_step, kill_mode = None, None
     report.tear = tear if crash_step is not None else None
+    report.kill_mode = kill_mode if kill_step is not None else None
 
     # --- setup (pre-arm): regions + channels, everyone synced --------
     writers: list[Container] = []
@@ -807,6 +951,17 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
     for c in all_containers:
         c.on("processed", _count_ack(c))
 
+    # broker coverage leg: one op per step through the partitioned
+    # queue so the broker seams (queue_append/consume) are covered in
+    # the SAME armed sweep the vacuity guard audits — and their
+    # absorption (produce retry, csn dedupe) is convergence-checked
+    # every run, not just in their unit tests
+    from ..service.partitioning import PartitionedOrderingService
+
+    broker = PartitionedOrderingService(n_partitions=1)
+    broker.produce_join("chaos-broker", ClientDetail("bk"))
+    broker_csn = 0
+
     schedule = standard_schedule(seed, sites)
     reconnect_rng = schedule.rng_for("reconnect")
     if faults:
@@ -835,6 +990,22 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
                 if not c.connected and not c.closed:
                     c.connect()
                     report.reconnects += 1
+        kill_now = kill_step is not None and step == kill_step
+        if kill_now and kill_mode == "under_lag":
+            # make replication lag REAL before the kill: the next
+            # offers defer, so the promoted follower carries a
+            # buffered (non-durable) tail into the election
+            PLANE.site("repl.lag").push(KIND_DEFER, 4)
+        if kill_now and kill_mode == "clean":
+            # deterministic promote-retry coverage: the election's
+            # first attempt fails transiently on every clean-kill
+            # seed, not just when the armed schedule happens to draw
+            PLANE.site("repl.promote").push(KIND_ERROR, 1)
+        if kill_now and kill_mode == "deposed_race":
+            # the grant lapses while the leader is ALIVE: this step's
+            # flushes below drive writes through the DEPOSED leader
+            # and every one must be refused by the epoch fence
+            harness.begin_depose()
         # one scripted action per alpha writer; beta edits 2x (it has
         # to outgrow the sidecar ladder into the pool tier). Every
         # client ALWAYS performs its scripted action — offline edits
@@ -854,10 +1025,30 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
             # else: think (flush below still runs)
             _safe_flush(c, all_containers, down_until, i, step,
                         reconnect_rng)
+            if kill_now and kill_mode == "mid_batch" and i == 0:
+                # kill MID-BATCH: writer A's flush is sequenced and
+                # replicated; B, C and beta flush into a dead plane
+                # and their edits ride the pending-resubmit path
+                harness.kill_leader("mid_batch")
+                for j in range(len(all_containers)):
+                    down_until[j] = step + 1 + \
+                        reconnect_rng.randrange(3)
         beta_edit()
         beta_edit()
         _safe_flush(beta, all_containers, down_until, 3, step,
                     reconnect_rng)
+        if kill_now and kill_mode in ("clean", "under_lag"):
+            # kill AFTER the step's flushes, BEFORE their pump — the
+            # crash-plan timing: the just-sequenced fanout frames die
+            # with the leader, and the replicated log is the only
+            # copy that survives
+            harness.kill_leader(kill_mode)
+            for j in range(len(all_containers)):
+                down_until[j] = step + 1 + reconnect_rng.randrange(3)
+        if kill_now and kill_mode == "deposed_race":
+            harness.complete_leader_swap()
+            for j in range(len(all_containers)):
+                down_until[j] = step + 1 + reconnect_rng.randrange(3)
         if step == crash_step:
             # crash AFTER this step's flushes and BEFORE their pump:
             # the just-sequenced ops' fanout frames die undelivered
@@ -898,6 +1089,18 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
                 harness.sidecar.apply()
             except TransientFault:
                 pass  # queued ops retry at the next round
+        # broker coverage leg: a double-fault append retries the SAME
+        # csn next step, so the expected sequence stays gapless
+        try:
+            broker.produce_op("chaos-broker", "bk", DocumentMessage(
+                client_sequence_number=broker_csn + 1,
+                reference_sequence_number=0,
+                type=MessageType.OPERATION,
+                contents={"v": broker_csn + 1}))
+            broker_csn += 1
+        except TransientFault:
+            pass
+        broker.pump()
     # --- quiesce: disarm, reconnect, drain to a fixed point ----------
     if faults:
         PLANE.disarm()
@@ -942,7 +1145,20 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
                 f"quiesce never drained pending state for {stuck}")
     harness.sidecar.sync()
     _check_convergence(report, harness, writers, beta)
+    # broker leg convergence: every successfully produced op sequenced
+    # exactly once (redelivery duplicates absorbed by the csn dedupe)
+    bops = [m.client_sequence_number
+            for m in broker.orderer("chaos-broker").op_log.read(0)
+            if m.type == MessageType.OPERATION]
+    if bops != list(range(1, broker_csn + 1)):
+        report.failures.append(
+            f"broker leg diverged: sequenced csns {bops} != "
+            f"1..{broker_csn}")
+    report.broker_ops = broker_csn
     report.crashes = harness.crashes
+    report.failovers = harness.failovers
+    if harness.group is not None:
+        report.repl_lag_max = harness.group.max_lag_observed
     report.acked_ops = acked_box[0]
     # PLANE.fired is reset by arm(): an unarmed (oracle) run must
     # report [] — not whatever sequence a PREVIOUS armed run left
@@ -1090,6 +1306,11 @@ class ChaosStormReport:
     chaos_counts: dict = field(default_factory=dict)
     fired: int = 0
     metrics_delta: dict = field(default_factory=dict)
+    # kill-the-leader leg (replicated plane; --kill-leader / config12)
+    kill_leader_step: Optional[int] = None
+    failover_time_s: Optional[float] = None
+    failovers: int = 0
+    repl_lag_max: int = 0
 
     def deterministic_fields(self) -> dict:
         return {
@@ -1099,13 +1320,18 @@ class ChaosStormReport:
             "recovery_steps": self.recovery_steps,
             "fired": self.fired,
             "converged": self.converged,
+            "kill_leader_step": self.kill_leader_step,
+            "failover_time_s": self.failover_time_s,
+            "failovers": self.failovers,
+            "repl_lag_max": self.repl_lag_max,
         }
 
 
 def run_chaos_storm(seed: int = 0, steps: int = 120,
                     storm: tuple[int, int] = (40, 80),
                     window: int = 8, slo_target: float = 0.95,
-                    sites: Optional[list[str]] = None
+                    sites: Optional[list[str]] = None,
+                    kill_leader_step: Optional[int] = None
                     ) -> ChaosStormReport:
     """Three phases on one step clock: steady (faults off), STORM
     (the standard schedule armed), recovery (faults off again).
@@ -1113,15 +1339,31 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
     its minimum from storm start on, and recovery time is how many
     steps past storm end it takes to hold the ``slo_target`` floor
     again for ``window`` consecutive steps. Deterministic per seed on
-    the step clock (wall time never enters the numbers)."""
+    the step clock (wall time never enters the numbers).
+
+    ``kill_leader_step`` runs the storm over the REPLICATED plane and
+    kills the leader at that step (mid-storm is the interesting
+    window): ``failover_time_s`` = step clock from the kill to the
+    first post-failover ack, reported next to ``goodput_dip`` —
+    bench config12's headline number."""
     import re
     import tempfile
 
+    if kill_leader_step is not None and not (
+            0 <= kill_leader_step < steps):
+        # an out-of-range kill step would silently never fire while
+        # the measurement guard (step >= kill_leader_step) fabricates
+        # a failover_time_s — refuse loudly instead
+        raise ValueError(
+            f"kill_leader_step {kill_leader_step} outside the run's "
+            f"step range [0, {steps})")
     report = ChaosStormReport(seed=seed, steps=steps,
-                              storm_steps=storm)
+                              storm_steps=storm,
+                              kill_leader_step=kill_leader_step)
     before = obs_metrics.REGISTRY.flat()
     durable = tempfile.mkdtemp(prefix="fftpu-chaos-storm-")
-    harness = ChaosHarness(durable)
+    harness = ChaosHarness(durable,
+                           replicated=kill_leader_step is not None)
     wl = random.Random(4242)
     schedule = standard_schedule(seed, sites)
     reconnect_rng = schedule.rng_for("reconnect")
@@ -1167,6 +1409,13 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
                 PLANE.arm(schedule)
             if step == storm_hi:
                 PLANE.disarm()
+            if kill_leader_step is not None \
+                    and step == kill_leader_step:
+                # zero-downtime host loss, measured: the leader dies
+                # mid-storm; a follower promotes at the replicated
+                # head; writers reconnect and the step clock from
+                # kill to first post-failover ack is failover_time_s
+                harness.kill_leader("clean")
             for i, when in list(down_until.items()):
                 if step >= when:
                     del down_until[i]
@@ -1188,6 +1437,11 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
             harness.pump()
             acked = sum(acked_total) - acked_prev
             acked_prev = sum(acked_total)
+            if (kill_leader_step is not None
+                    and step >= kill_leader_step
+                    and report.failover_time_s is None and acked):
+                report.failover_time_s = round(
+                    (step - kill_leader_step) * 0.05, 6)
             report.offered_ops += offered
             report.acked_ops += acked
             rolling.append((offered, acked))
@@ -1236,6 +1490,15 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
                     report.failures.append(
                         f"marker {marker!r} x{final.count(marker)}")
         report.converged = not report.failures
+        report.failovers = harness.failovers
+        if harness.group is not None:
+            report.repl_lag_max = harness.group.max_lag_observed
+            if kill_leader_step is not None \
+                    and report.failover_time_s is None:
+                report.failures.append(
+                    "no ack ever landed after the leader kill — "
+                    "failover never completed")
+                report.converged = False
         # arm() reset PLANE.fired at storm start, so the count is
         # this storm's own; a run whose window never armed reports 0
         report.fired = len(PLANE.fired) if steps > storm_lo else 0

@@ -438,14 +438,34 @@ def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
     parser.add_argument("--chaos-storm", type=int, nargs=2,
                         default=(40, 80), metavar=("LO", "HI"),
                         help="storm window [LO, HI) in steps")
+    parser.add_argument("--kill-leader", type=int, nargs="?",
+                        const=-1, default=None, metavar="STEP",
+                        help="with --chaos: run the storm over the "
+                             "REPLICATED sequencer plane and kill "
+                             "the leader at STEP (default: "
+                             "mid-storm); reports failover_time_s "
+                             "and repl_lag_max next to goodput_dip "
+                             "— a failing failover seed reproduces "
+                             "from this CLI alone")
     args = parser.parse_args(argv)
+    if args.kill_leader is not None and args.chaos is None:
+        parser.error("--kill-leader requires --chaos SEED")
     if args.chaos is not None:
         from ..testing.chaos import run_chaos_storm
 
+        kill_step = args.kill_leader
+        if kill_step == -1:
+            kill_step = sum(args.chaos_storm) // 2  # mid-storm
+        if kill_step is not None and not (
+                0 <= kill_step < args.chaos_steps):
+            parser.error(
+                f"--kill-leader {kill_step} outside the step range "
+                f"[0, {args.chaos_steps})")
         report = run_chaos_storm(
             seed=args.chaos, steps=args.chaos_steps,
             storm=tuple(args.chaos_storm),
             sites=args.sites.split(",") if args.sites else None,
+            kill_leader_step=kill_step,
         )
         print(json.dumps({
             "seed": report.seed,
@@ -457,6 +477,10 @@ def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
             "goodput_dip": round(report.goodput_dip, 4),
             "recovery_steps": report.recovery_steps,
             "recovery_time_s": report.recovery_time_s,
+            "kill_leader_step": report.kill_leader_step,
+            "failover_time_s": report.failover_time_s,
+            "failovers": report.failovers,
+            "repl_lag_max": report.repl_lag_max,
             "converged": report.converged,
             "failures": report.failures,
             "fired": report.fired,

@@ -31,14 +31,32 @@ from fluidframework_tpu.qos.faults import (
     standard_rates,
 )
 from fluidframework_tpu.testing.chaos import (
+    KILL_MODES,
     ChaosHarness,
     crash_plan,
+    failover_plan,
     run_chaos,
+    run_chaos_failover,
     run_chaos_storm,
     standard_schedule,
 )
 
 N_SEEDS = 20
+
+# chaos-coverage vacuity accumulator: both 20-seed sweeps record
+# which sites actually fired (and which were registered at the time);
+# the guard test at the bottom audits the union — non-vacuity as a
+# STRUCTURAL property instead of a hand-check (PR9 found a vacuous
+# torn-tail state by hand; this makes the next one fail loudly)
+_SWEEP_FIRED: set = set()
+_SWEEP_SITES: set = set()
+_SWEEP_RUNS: list = []
+
+
+def _sweep_record(report) -> None:
+    _SWEEP_FIRED.update(site for site, _, _ in report.fired)
+    _SWEEP_SITES.update(PLANE.sites())
+    _SWEEP_RUNS.append(report.seed)
 
 
 @pytest.fixture(scope="module")
@@ -69,6 +87,7 @@ def test_chaos_convergence_differential(seed, oracle):
     )
     assert report.converged, detail
     assert len(report.fired) > 0, f"seed {seed}: no faults fired"
+    _sweep_record(report)
     if report.tear is not None:
         # coverage must be REAL: a tear the barrier refused (e.g. a
         # log tail some client already processed) is a vacuous pass
@@ -80,6 +99,80 @@ def test_chaos_convergence_differential(seed, oracle):
     assert report.alpha_text == oracle.alpha_text, detail
     assert report.alpha_kv == oracle.alpha_kv, detail
     assert report.beta_text == oracle.beta_text, detail
+
+
+# ----------------------------------------------------------------------
+# the kill-the-leader differential (replicated sequencer plane)
+
+
+@pytest.fixture(scope="module")
+def failover_oracle(oracle):
+    """The replicated plane's fault-free oracle — and the replication
+    TRANSPARENCY check: with nothing armed and no kill, the
+    replicated plane must land on the exact same converged state as
+    the plain plane (replication is an availability property, never a
+    semantic one)."""
+    report = run_chaos_failover(0, faults=False)
+    assert report.converged, report.failures
+    assert report.alpha_text == oracle.alpha_text
+    assert report.alpha_kv == oracle.alpha_kv
+    assert report.beta_text == oracle.beta_text
+    return report
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_failover_convergence_differential(seed, failover_oracle):
+    """ROADMAP item 3's acceptance: 20 seeded kill-the-leader
+    schedules — leader killed mid-batch, follower promoted with real
+    replication lag, a deposed leader racing the new epoch — each
+    bit-identical to the fault-free oracle. A failing seed reproduces
+    alone: ``run_chaos_failover(seed)``."""
+    report = run_chaos_failover(seed)
+    kill_step, kill_mode = failover_plan(seed, 40)
+    detail = (
+        f"seed {seed} (reproduce: run_chaos_failover({seed})), "
+        f"kill={kill_mode}@{kill_step}, "
+        f"failovers={report.failovers}, "
+        f"fenced={report.fenced_writes}, "
+        f"lag_max={report.repl_lag_max}: {report.failures}"
+    )
+    assert report.converged, detail
+    assert len(report.fired) > 0, f"seed {seed}: no faults fired"
+    _sweep_record(report)
+    if kill_step is not None:
+        assert report.failovers >= 1, detail
+        assert report.kill_mode == kill_mode
+    if kill_mode == "deposed_race":
+        # the split-brain candidate MUST have been refused by the
+        # epoch fence, or the mode tested nothing
+        assert report.fenced_writes > 0, detail
+    if kill_mode == "under_lag":
+        assert report.repl_lag_max > 0, detail
+    # bit-identical to the fault-free oracle: zero-downtime host loss
+    # means the ORDER survives, not just availability
+    assert report.alpha_text == failover_oracle.alpha_text, detail
+    assert report.alpha_kv == failover_oracle.alpha_kv, detail
+    assert report.beta_text == failover_oracle.beta_text, detail
+
+
+def test_seed_range_covers_every_kill_mode():
+    """Structural: within the N seeds, every enumerated kill mode
+    (clean host loss, mid-batch, promotion under lag, deposed race)
+    appears at least once, plus a no-kill replicated run
+    (failover_plan is a pure function of the seed)."""
+    plans = [failover_plan(seed, 40) for seed in range(N_SEEDS)]
+    modes = {m for _, m in plans if m is not None}
+    assert modes == set(KILL_MODES), modes
+    assert any(step is None for step, _ in plans), (
+        "some seeds must run the armed schedule over the replicated "
+        "plane with NO kill — replication must survive plain chaos")
+
+
+def test_failover_runs_are_deterministic():
+    a = run_chaos_failover(6)  # deposed_race: the hairiest mode
+    b = run_chaos_failover(6)
+    assert a.fired == b.fired
+    assert a.deterministic_fields() == b.deterministic_fields()
 
 
 def test_seed_range_covers_crash_and_torn_states():
@@ -115,6 +208,8 @@ def test_sites_registered_at_every_seam():
     import fluidframework_tpu.service.storage  # noqa: F401
     import fluidframework_tpu.service.tpu_sidecar  # noqa: F401
 
+    import fluidframework_tpu.service.replication  # noqa: F401
+
     names = set(PLANE.sites())
     assert {
         "socket.frame_in", "socket.frame_out",
@@ -123,6 +218,8 @@ def test_sites_registered_at_every_seam():
         "sidecar.dispatch", "sidecar.pool_dispatch",
         "sidecar.pool_admit", "sidecar.pool_migrate",
         "ingress.summary_upload",
+        "repl.lag", "repl.append_ack",
+        "repl.lease_expire", "repl.promote",
     } <= names
 
 
@@ -470,8 +567,59 @@ def test_stress_cli_chaos_mode(tmp_path):
     assert payload["converged"] is True
     assert payload["fired"] > 0
     assert "goodput_dip" in payload and "recovery_time_s" in payload
+    assert payload["failover_time_s"] is None  # no --kill-leader
     assert any(k.startswith("chaos_injected_total")
                for k in payload["chaos_counts"])
+
+
+def test_chaos_storm_kill_leader_measures_failover():
+    """The storm over the replicated plane with the leader killed
+    mid-storm: goodput dips, a follower promotes, failover_time_s is
+    measured on the step clock — and the whole thing is bit-equal
+    across runs (config12's contract)."""
+    a = run_chaos_storm(seed=2, steps=90, storm=(30, 60),
+                        kill_leader_step=45)
+    assert a.converged, a.failures
+    assert a.failovers >= 1
+    assert a.failover_time_s is not None and a.failover_time_s >= 0
+    assert a.recovery_steps is not None, (
+        "goodput must recover after the failover")
+    b = run_chaos_storm(seed=2, steps=90, storm=(30, 60),
+                        kill_leader_step=45)
+    assert a.deterministic_fields() == b.deterministic_fields()
+
+
+def test_stress_cli_kill_leader_mode():
+    """A failing failover seed must reproduce from the CLI alone:
+    tools/stress --chaos SEED --kill-leader [STEP]."""
+    from fluidframework_tpu.tools import stress
+
+    rc, out = _run_cli(stress, ["--chaos", "3", "--chaos-steps", "60",
+                                "--chaos-storm", "20", "40",
+                                "--kill-leader"])
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload["converged"] is True
+    assert payload["kill_leader_step"] == 30  # mid-storm default
+    assert payload["failovers"] >= 1
+    assert payload["failover_time_s"] is not None
+    assert "repl_lag_max" in payload
+
+    # --kill-leader without --chaos is a usage error
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stderr(buf), pytest.raises(SystemExit):
+        stress.main(["--kill-leader", "10"])
+    # an out-of-range kill step is refused loudly (it would silently
+    # never fire while the measurement fabricated a failover time)
+    buf = io.StringIO()
+    with contextlib.redirect_stderr(buf), pytest.raises(SystemExit):
+        stress.main(["--chaos", "1", "--chaos-steps", "60",
+                     "--kill-leader", "99"])
+    with pytest.raises(ValueError):
+        run_chaos_storm(seed=1, steps=60, kill_leader_step=-3)
 
 
 def _run_cli(mod, argv):
@@ -579,3 +727,49 @@ def test_schedule_rng_for_is_stable():
     s = standard_schedule(9)
     assert s.rng_for("x").random() == s.rng_for("x").random()
     assert s.rng_for("x").random() != s.rng_for("y").random()
+
+
+# ----------------------------------------------------------------------
+# chaos-coverage vacuity guard (MUST stay the last test in this file:
+# it audits the union of both 20-seed sweeps above)
+
+# sites the differential harnesses structurally cannot reach, each
+# with the coverage that stands in. This list is a CONTRACT, audited
+# both ways: a listed site that starts firing in the sweep fails
+# (stale exemption), and an unlisted registered site that never fires
+# fails (vacuous coverage — the PR9 torn-tail lesson, structural).
+SWEEP_EXEMPT = {
+    # the chaos sidecar rides the seq route; migration is a mesh-pool
+    # seam, chaos-covered by tests/test_mesh_pool.py + config10
+    "sidecar.pool_migrate": "mesh route only (tests/test_mesh_pool)",
+    # scripted-only vocabulary (CORRUPT frames); fired by
+    # tests/test_broker.py via the ScriptedFrameServer harness
+    "testing.scripted_frame": "scripted-only (tests/test_broker)",
+}
+
+
+def test_sweep_fires_every_registered_site():
+    """Every injection site registered on the PLANE during the two
+    20-seed sweeps fired at least once across them (test.* fixture
+    sites and the audited SWEEP_EXEMPT contract aside). A new seam
+    whose site never fires under the standard schedule fails HERE —
+    vacuous chaos coverage is a bug, not a gap."""
+    if len(_SWEEP_RUNS) < 2 * N_SEEDS:
+        pytest.skip("needs the full 2x20-seed sweep in this session")
+    auditable = {
+        name for name in _SWEEP_SITES
+        if not name.startswith("test.")
+    }
+    silent = sorted(auditable - SWEEP_EXEMPT.keys() - _SWEEP_FIRED)
+    assert silent == [], (
+        f"registered sites that never fired across "
+        f"{len(_SWEEP_RUNS)} seeded runs: {silent} — either drive "
+        "the seam in the sweep (standard_rates + workload) or add an "
+        "audited SWEEP_EXEMPT entry naming its coverage")
+    stale = sorted(SWEEP_EXEMPT.keys() & _SWEEP_FIRED)
+    assert stale == [], (
+        f"stale SWEEP_EXEMPT entries (they DO fire now): {stale}")
+    # the repl seams specifically must be live in the sweep — the
+    # tentpole's own coverage can never go vacuous silently
+    assert {"repl.lag", "repl.append_ack", "repl.lease_expire",
+            "repl.promote"} <= _SWEEP_FIRED

@@ -404,6 +404,86 @@ def test_torn_versions_tail_is_discarded_and_rewritten(tmp_path):
     assert [v.sequence_number for v in st3.versions] == [1, 3]
 
 
+def test_queue_commit_offset_is_crash_atomic(tmp_path):
+    """FileOrderingQueue.commit used to plain-overwrite the offset
+    file — a crash mid-write could leave a TORN offset. It now routes
+    through storage.atomic_write (asserted structurally), leaves no
+    tmp debris, and tolerates pre-barrier debris on load."""
+    from fluidframework_tpu.service import partitioning as part
+    from fluidframework_tpu.service.partitioning import (
+        FileOrderingQueue,
+    )
+
+    q = FileOrderingQueue(str(tmp_path / "q"), 1)
+    q.produce(0, "d", {"v": 1})
+    calls = []
+    real = part.atomic_write
+
+    def spy(path, data):
+        calls.append(path)
+        real(path, data)
+
+    part.atomic_write = spy
+    try:
+        q.commit(0, 0)
+    finally:
+        part.atomic_write = real
+    assert calls and calls[0].endswith("partition-0.offset"), (
+        "commit must route through the shared crash-atomic barrier")
+    assert not os.path.exists(
+        str(tmp_path / "q" / "partition-0.offset.tmp"))
+    # stale .tmp debris (crash between write and rename) is cleared
+    # on load and the committed file stays the truth
+    debris = tmp_path / "q" / "partition-0.offset.tmp"
+    debris.write_text("99")
+    q2 = FileOrderingQueue(str(tmp_path / "q"), 1)
+    assert q2.committed(0) == 0
+    assert not debris.exists()
+
+
+def test_torn_queue_offset_states_are_pinned(tmp_path):
+    """The enumerated torn-offset states the old plain overwrite
+    permitted: (a) a numeric PREFIX ("1" torn from "15") silently
+    rewinds the checkpoint — absorbed, because consumers re-read from
+    the committed offset and the at-least-once dedupe drops replays;
+    (b) garbage degrades LOUDLY to 'no commit' instead of crashing
+    the partition load."""
+    from fluidframework_tpu.service.partitioning import (
+        FileOrderingQueue,
+    )
+
+    root = str(tmp_path / "q")
+    q = FileOrderingQueue(root, 1)
+    for i in range(16):
+        q.produce(0, "d", {"v": i})
+    q.commit(0, 14)
+    offset = tmp_path / "q" / "partition-0.offset"
+    # (a) torn numeric prefix: "1" of "14" — a legal rewind
+    offset.write_text("1")
+    q2 = FileOrderingQueue(root, 1)
+    assert q2.committed(0) == 1
+    assert [r.offset for r in q2.read(0, q2.committed(0) + 1)] == \
+        list(range(2, 16)), "re-consume from the rewound offset"
+    # monotone guard: a late commit below the head is still honored
+    # forward, never backward
+    q2.commit(0, 14)
+    assert q2.committed(0) == 14
+    # (b) garbage: degrade loudly to -1, never crash the load
+    before = __import__(
+        "fluidframework_tpu.obs.metrics",
+        fromlist=["REGISTRY"]).REGISTRY.flat().get(
+        'storage_torn_recoveries_total{file="queue-offset"}', 0)
+    offset.write_text("not-a-number")
+    q3 = FileOrderingQueue(root, 1)
+    assert q3.committed(0) == -1
+    assert [r.offset for r in q3.read(0, 0)][:2] == [0, 1]
+    after = __import__(
+        "fluidframework_tpu.obs.metrics",
+        fromlist=["REGISTRY"]).REGISTRY.flat().get(
+        'storage_torn_recoveries_total{file="queue-offset"}', 0)
+    assert after == before + 1, "the degrade must be LOUD"
+
+
 def test_gap_over_truncated_log_raises_actionably(tmp_path):
     """A replica behind a summary-truncated log whose reconnect-time
     catch-up was EMPTY (no trailing ops yet) must fail with the loud

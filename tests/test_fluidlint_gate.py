@@ -331,6 +331,88 @@ def test_retry_without_jitter_rule(tmp_path):
     ) == []
 
 
+def test_fence_before_fanout_rule(tmp_path):
+    """qoscheck:fence-before-fanout — a call to a replication gate
+    (the reviewed FANOUT_GATES registry) in a service path must be
+    textually preceded, in the same function, by an epoch fence
+    check; both ``<...>.fence.check(...)`` and ``check_epoch(...)``
+    spellings count, suppression works, non-service paths are out of
+    scope."""
+    svc = tmp_path / "service"
+    svc.mkdir()
+    bad = svc / "bad.py"
+    bad.write_text(
+        "class Log:\n"
+        "    def persist(self, msg):\n"
+        "        self.write(msg)\n"
+        "        self.group.replicate_before_fanout(msg)\n"   # BAD
+        "    def persist_checked(self, msg):\n"
+        "        self.group.fence.check(self.epoch)\n"
+        "        self.group.replicate_before_fanout(msg)\n"   # ok
+        "    def persist_epoch(self, msg):\n"
+        "        check_epoch(self.epoch)\n"
+        "        self._replicate_before_fanout(msg)\n"        # ok
+        "    def persist_late_fence(self, msg):\n"
+        "        self._replicate_before_fanout(msg)\n"        # BAD
+        "        self.group.fence.check(self.epoch)\n"
+        "    def persist_justified(self, msg):\n"
+        "        self.group.replicate_before_fanout(msg)  "
+        "# fluidlint: disable=fence-before-fanout -- test\n"
+        "    def persist_nested_fence(self, msg):\n"
+        "        def helper():\n"
+        "            self.group.fence.check(self.epoch)\n"
+        "        self.group.replicate_before_fanout(msg)\n"  # BAD
+        "    def persist_nested_gate(self, msg):\n"
+        "        def flush():\n"
+        "            self.group.replicate_before_fanout(msg)\n"  # BAD
+        "        flush()\n"
+    )
+    findings = [f for f in core.run_analysis(
+        roots=[str(bad)], families=["qoscheck"])
+        if f.rule == "fence-before-fanout"]
+    assert sorted(f.key for f in findings) == [
+        "bad.py:Log.persist.fanout",
+        "bad.py:Log.persist_late_fence.fanout",
+        # a fence check hidden inside a nested helper does NOT guard
+        # the outer gate — the hoist the rule exists to catch
+        "bad.py:Log.persist_nested_fence.fanout",
+        # a gate inside a nested def is ONE finding against the
+        # nested scope, not a duplicate against the method too
+        "bad.py:flush.fanout",
+    ]
+
+    # the same code outside a service path component is not the
+    # rule's business (the replicated sequencer lives in service/)
+    other = tmp_path / "elsewhere.py"
+    other.write_text(
+        "def persist(group, msg):\n"
+        "    group.replicate_before_fanout(msg)\n"
+    )
+    assert [f for f in core.run_analysis(
+        roots=[str(other)], families=["qoscheck"])
+        if f.rule == "fence-before-fanout"] == []
+
+
+def test_fence_before_fanout_live_tree_is_clean():
+    """The replicated sequencer's real gates (document plane +
+    partitioned queue) all check the fence first — and the rule
+    actually SEES them (non-vacuity: the gate callees exist in the
+    scanned tree)."""
+    findings = [
+        f for f in core.run_analysis(families=["qoscheck"])
+        if f.rule == "fence-before-fanout"
+    ]
+    assert findings == [], [f.key for f in findings]
+    import ast as _ast
+
+    repl = open("fluidframework_tpu/service/replication.py").read()
+    gates = [n for n in _ast.walk(_ast.parse(repl))
+             if isinstance(n, _ast.Call)
+             and getattr(n.func, "attr", None)
+             and n.func.attr.lstrip("_") == "replicate_before_fanout"]
+    assert gates, "the rule's registry no longer matches the code"
+
+
 def test_retry_without_jitter_live_tree_is_clean():
     findings = [
         f for f in core.run_analysis(families=["qoscheck"])

@@ -1,0 +1,540 @@
+"""Replicated sequencer (service/replication.py): the ack barrier
+(fsync-and-replicate-before-fanout), the lease/epoch-fence seam, and
+follower promotion at exactly the replicated head — plus the
+partitioned plane's replicated queue/checkpoint counterparts.
+
+The end-to-end proof lives in tests/test_chaos.py (the 20-seed
+kill-the-leader differential); this file pins each mechanism in
+isolation so a failover bug names its broken piece.
+"""
+import json
+import os
+
+import pytest
+
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.obs import metrics as obs_metrics
+from fluidframework_tpu.qos.faults import (
+    KIND_DEFER,
+    KIND_DROP,
+    PLANE,
+)
+from fluidframework_tpu.service.replication import (
+    EpochFence,
+    FencedWriteError,
+    FollowerReplica,
+    LeaseHeldError,
+    ReplicatedSequencerGroup,
+    SequencerLease,
+)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _group(tmp_path, **kw):
+    clock = _Clock()
+    kw.setdefault("n_followers", 2)
+    g = ReplicatedSequencerGroup(str(tmp_path), clock=clock, **kw)
+    return g, clock
+
+
+def _load_writer(group, doc="doc", client="w"):
+    factory = LocalDocumentServiceFactory(group.server)
+    c = Container.load(factory.create_document_service(doc),
+                       client_id=client)
+    return c
+
+
+def _text_channel(c):
+    return c.runtime.get_datastore("app").get_channel("t")
+
+
+def _drive(c, n=5, tag="x"):
+    ds = c.runtime.datastores.get("app") or \
+        c.runtime.create_datastore("app")
+    if "t" not in ds.channels:
+        ds.create_channel("sharedstring", "t")
+    t = _text_channel(c)
+    for i in range(n):
+        t.insert_text(0, f"{tag}{i}.")
+        c.flush()
+    return t.get_text()
+
+
+# ----------------------------------------------------------------------
+# lease + fence
+
+
+def test_lease_acquire_bumps_epoch_and_refuses_live_contender():
+    clock = _Clock()
+    fence = EpochFence()
+    lease = SequencerLease(fence, ttl=1.0, clock=clock)
+    assert lease.acquire("a") == 1
+    with pytest.raises(LeaseHeldError):
+        lease.acquire("b")
+    clock.t += 1.1  # TTL lapses, nobody renewed
+    assert lease.expired()
+    assert lease.acquire("b") == 2
+    assert fence.epoch == 2
+
+
+def test_lease_renew_extends_and_refuses_deposed_caller():
+    clock = _Clock()
+    fence = EpochFence()
+    lease = SequencerLease(fence, ttl=1.0, clock=clock)
+    epoch_a = lease.acquire("a")
+    clock.t += 0.9
+    assert lease.renew("a", epoch_a) is True
+    clock.t += 0.9  # inside the renewed window
+    assert not lease.expired()
+    clock.t += 0.2
+    epoch_b = lease.acquire("b")
+    # the deposed holder's renewal is refused without consulting the
+    # chaos site (it is not a fault — the grant simply moved on)
+    assert lease.renew("a", epoch_a) is False
+    assert lease.renew("b", epoch_b) is True
+
+
+def test_lease_renewal_drop_and_spurious_expiry_faults():
+    clock = _Clock()
+    lease = SequencerLease(EpochFence(), ttl=1.0, clock=clock)
+    epoch = lease.acquire("a")
+    site = PLANE.site("repl.lease_expire")
+    site.push(KIND_DROP, 1)
+    deadline = lease.expires_at
+    assert lease.renew("a", epoch) is False
+    assert lease.expires_at == deadline, (
+        "a dropped renewal must leave the TTL running, not reset it")
+    from fluidframework_tpu.qos.faults import KIND_ERROR
+
+    site.push(KIND_ERROR, 1)
+    assert lease.renew("a", epoch) is False
+    assert lease.expired(), (
+        "the error fault models the lease service lapsing the grant "
+        "NOW — the split-brain trigger")
+
+
+def test_fence_counts_and_raises_on_stale_epoch():
+    fence = EpochFence()
+    fence.advance()
+    before = obs_metrics.REGISTRY.flat().get(
+        "sequencer_fenced_writes_total", 0)
+    fence.check(1)  # current epoch: fine
+    fence.advance()
+    with pytest.raises(FencedWriteError):
+        fence.check(1)
+    assert obs_metrics.REGISTRY.flat()[
+        "sequencer_fenced_writes_total"] == before + 1
+
+
+# ----------------------------------------------------------------------
+# follower replica
+
+
+def _msg(seq, v=0):
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    return SequencedMessage(
+        client_id="w", sequence_number=seq,
+        minimum_sequence_number=0, client_sequence_number=seq,
+        reference_sequence_number=0, type=MessageType.OPERATION,
+        contents={"v": v}, timestamp=0.0)
+
+
+def test_follower_append_is_contiguous_and_durable(tmp_path):
+    f = FollowerReplica(str(tmp_path / "n1"), "n1")
+    f.append_durable("d", 1, _msg(1))
+    f.append_durable("d", 1, _msg(2))
+    assert f.head("d") == 2
+    with pytest.raises(AssertionError):
+        f.append_durable("d", 1, _msg(4))  # gap refused
+    # durable: a fresh replica over the same dir resumes the head
+    f.close()
+    f2 = FollowerReplica(str(tmp_path / "n1"), "n1")
+    assert f2.head("d") == 2
+    assert [m.sequence_number for m in f2.read_log("d")] == [1, 2]
+
+
+def test_follower_lag_buffer_flushes_contiguous_prefix_only(tmp_path):
+    f = FollowerReplica(str(tmp_path / "n1"), "n1")
+    f.append_durable("d", 1, _msg(1))
+    f.buffer_lag("d", 1, _msg(3))  # op 2 never arrived (dropped)
+    f.buffer_lag("d", 1, _msg(4))
+    assert f.flush_lag("d") == 0
+    assert f.head("d") == 1 and f.lag_depth() == 2, (
+        "a gapped buffer must stay buffered, not tear a hole in the "
+        "contiguous log")
+    f.sync_from("d", [_msg(2)])  # catch-up supplies the middle
+    assert f.flush_lag("d") == 2
+    assert f.head("d") == 4 and f.lag_depth() == 0
+
+
+def test_follower_refuses_stale_epoch(tmp_path):
+    f = FollowerReplica(str(tmp_path / "n1"), "n1")
+    f.append_durable("d", 2, _msg(1))
+    with pytest.raises(FencedWriteError):
+        f.append_durable("d", 1, _msg(2))
+    with pytest.raises(FencedWriteError):
+        f.buffer_lag("d", 1, _msg(2))
+
+
+def test_follower_torn_tail_discarded_on_restart(tmp_path):
+    f = FollowerReplica(str(tmp_path / "n1"), "n1")
+    for s in (1, 2, 3):
+        f.append_durable("d", 1, _msg(s))
+    f.close()
+    path = tmp_path / "n1" / "d" / "ops.jsonl"
+    lines = path.read_bytes().splitlines(keepends=True)
+    path.write_bytes(b"".join(lines[:-1])
+                     + lines[-1][: len(lines[-1]) // 2])
+    f2 = FollowerReplica(str(tmp_path / "n1"), "n1")
+    assert f2.head("d") == 2, (
+        "the torn tail op never acked, so discarding it is exact")
+    # and the log was rewritten whole: appending works again
+    f2.append_durable("d", 1, _msg(3, v=9))
+    f2.close()
+    rows = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [r["sequenceNumber"] for r in rows] == [1, 2, 3]
+
+
+# ----------------------------------------------------------------------
+# the group: barrier, committed watermark, failover
+
+
+def test_every_append_is_quorum_durable_before_return(tmp_path):
+    g, _ = _group(tmp_path)
+    c = _load_writer(g)
+    _drive(c, 3)
+    doc_head = g.server.get_orderer("doc").op_log.last_seq
+    assert g.committed("doc") == doc_head
+    # quorum=2 of 3: at least one follower must hold EVERY op
+    assert max(f.head("doc") for f in g.followers) == doc_head
+    c.close()
+
+
+def test_lag_deferred_follower_trails_but_quorum_holds(tmp_path):
+    g, _ = _group(tmp_path)
+    c = _load_writer(g)
+    _drive(c, 2)
+    # defer the next TWO offers: one per follower for one append —
+    # the barrier must then BLOCK and force-sync one of them
+    PLANE.site("repl.lag").push(KIND_DEFER, 2)
+    _text_channel(c).insert_text(0, "L.")
+    c.flush()
+    head = g.server.get_orderer("doc").op_log.last_seq
+    assert g.committed("doc") == head
+    heads = sorted(f.head("doc") for f in g.followers)
+    assert heads[-1] == head, "quorum requires one durable follower"
+    assert g.max_lag_observed > 0
+    c.close()
+
+
+def test_dropped_ack_catches_up_on_next_append(tmp_path):
+    g, _ = _group(tmp_path)
+    c = _load_writer(g)
+    _drive(c, 2)
+    # drop both attempts (first + retry) for ONE follower's next offer
+    PLANE.site("repl.append_ack").push(KIND_DROP, 2)
+    _text_channel(c).insert_text(0, "D.")
+    c.flush()
+    _text_channel(c).insert_text(0, "E.")
+    c.flush()
+    head = g.server.get_orderer("doc").op_log.last_seq
+    # the clean second append triggered catch-up: both followers whole
+    assert [f.head("doc") for f in g.followers] == [head, head]
+    c.close()
+
+
+def test_failover_resumes_ticketing_at_replicated_head(tmp_path):
+    g, clock = _group(tmp_path)
+    c = _load_writer(g)
+    final = _drive(c, 5)
+    before = obs_metrics.REGISTRY.flat().get(
+        "sequencer_failovers_total", 0)
+    head = g.server.get_orderer("doc").op_log.last_seq
+    g.kill_leader()
+    clock.t += 1.0
+    g.failover()
+    assert g.epoch == 2 and g.leader_id in ("node-1", "node-2")
+    assert obs_metrics.REGISTRY.flat()[
+        "sequencer_failovers_total"] == before + 1
+    # the promoted orderer resumes at EXACTLY the replicated head
+    orderer = g.server.get_orderer("doc")
+    assert orderer.sequencer.sequence_number == orderer.op_log.last_seq
+    assert orderer.op_log.last_seq >= head
+    r = _load_writer(g, client="r")
+    assert _text_channel(r).get_text() == final
+    # and new writes sequence contiguously on the new leader
+    _text_channel(r).insert_text(0, "post.")
+    r.flush()
+    assert _text_channel(r).get_text() == "post." + final
+    r.close()
+
+
+def test_promotion_under_lag_lands_on_exact_head(tmp_path):
+    g, clock = _group(tmp_path)
+    c = _load_writer(g)
+    _drive(c, 3)
+    PLANE.site("repl.lag").push(KIND_DEFER, 4)
+    final = _drive(c, 2, tag="z")
+    laggard = g.laggiest_follower()
+    head = g.server.get_orderer("doc").op_log.last_seq
+    assert laggard.head("doc") < head, "the kill must catch real lag"
+    g.kill_leader()
+    clock.t += 1.0
+    g.failover(candidate=laggard)  # promote the LAGGIEST on purpose
+    orderer = g.server.get_orderer("doc")
+    assert orderer.op_log.last_seq == head, (
+        "flush + anti-entropy must land the laggard on the exact "
+        "replicated head before it serves")
+    r = _load_writer(g, client="r")
+    assert _text_channel(r).get_text() == final
+    r.close()
+
+
+def test_deposed_leader_is_fenced_on_write_and_read(tmp_path):
+    g, clock = _group(tmp_path)
+    c = _load_writer(g)
+    _drive(c, 3)
+    c.close()  # a close after deposition would itself be fenced
+    old_server = g.server
+    g.lease.force_expire(reason="test")
+    g.failover()
+    # writes through the old leader refuse BEFORE consuming seqs
+    orderer = old_server.documents["doc"]
+    seq_before = orderer.sequencer.sequence_number
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+
+    with pytest.raises(FencedWriteError):
+        orderer.submit("w", DocumentMessage(
+            client_sequence_number=99, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={}))
+    assert orderer.sequencer.sequence_number == seq_before
+    with pytest.raises(FencedWriteError):
+        old_server.connect("doc", "z", on_message=lambda m: None)
+    # the deposed read path refuses too: its view may disagree with
+    # the order the new leader is minting
+    with pytest.raises(FencedWriteError):
+        old_server.read_ops("doc", 0)
+
+
+def test_deposed_teardown_does_not_detonate(tmp_path):
+    """Session teardown on a DEPOSED node (a transport death during
+    the deposed-race window runs close() -> conn.disconnect() ->
+    orderer.disconnect) must NOT raise through the cleanup path: the
+    leave a fenced node sequences could never reach a client anyway.
+    Joins/submits still refuse loudly — only teardown is absorbed."""
+    g, clock = _group(tmp_path)
+    msgs = []
+    conn = g.server.connect("doc", "w", on_message=msgs.append)
+    g.lease.force_expire(reason="test")
+    g.failover()
+    conn.disconnect()  # must not raise
+    # joins still refuse loudly, and the fence context names the
+    # refused operation truthfully (was mislabeled "submit")
+    from fluidframework_tpu.protocol.messages import ClientDetail
+
+    with pytest.raises(FencedWriteError, match="'op': 'connect'"):
+        conn._orderer.connect(ClientDetail("z"))
+
+
+def test_second_failover_shrinks_quorum_and_still_serves(tmp_path):
+    g, clock = _group(tmp_path)
+    c = _load_writer(g)
+    final = _drive(c, 3)
+    g.kill_leader()
+    clock.t += 1.0
+    g.failover()
+    r = _load_writer(g, client="r1")
+    final = "a." + final
+    _text_channel(r).insert_text(0, "a.")
+    r.flush()
+    r.close()
+    g.kill_leader()
+    clock.t += 1.0
+    g.failover()
+    assert g.quorum == 1 + len(g.followers) <= 2
+    r2 = _load_writer(g, client="r2")
+    assert _text_channel(r2).get_text() == final
+    r2.close()
+
+
+def test_summary_truncation_clamped_to_replication_floor(tmp_path):
+    g, _ = _group(tmp_path)
+    c = _load_writer(g)
+    _drive(c, 3)
+    PLANE.site("repl.lag").push(KIND_DEFER, 4)
+    _drive(c, 2, tag="q")
+    floor = g.replication_floor("doc")
+    head = g.server.get_orderer("doc").op_log.last_seq
+    assert floor < head
+    log = g.server.get_orderer("doc").op_log
+    log.truncate_below(head)  # a summary ack would ask for this
+    remaining = [m.sequence_number for m in log.read(0)]
+    assert remaining and remaining[0] == floor + 1, (
+        "truncation must never outrun the laggiest follower — the "
+        "leader log is its catch-up source")
+    c.close()
+
+
+def test_group_metrics_registered_and_move(tmp_path):
+    g, clock = _group(tmp_path)
+    flat = obs_metrics.REGISTRY.flat()
+    assert flat.get('repl_followers{partition="docs"}') == 2
+    assert flat.get("repl_epoch", 0) >= 1
+    c = _load_writer(g)
+    _drive(c, 2)
+    c.close()
+    g.kill_leader()
+    clock.t += 1.0
+    g.failover()
+    flat = obs_metrics.REGISTRY.flat()
+    assert flat['repl_followers{partition="docs"}'] == 1
+
+
+def test_group_refuses_followerless_and_unsatisfiable_quorum(tmp_path):
+    with pytest.raises(ValueError):
+        ReplicatedSequencerGroup(str(tmp_path / "a"), n_followers=0)
+    with pytest.raises(ValueError):
+        ReplicatedSequencerGroup(str(tmp_path / "b"), n_followers=1,
+                                 quorum=3)
+
+
+def test_default_quorum_is_a_strict_majority(tmp_path):
+    """For EVEN group sizes too: 4 nodes need 3 acks — at quorum 2,
+    losing leader + the one acked follower (a minority) would lose a
+    client-acked op that anti-entropy can never recover."""
+    for n_followers, want in ((1, 2), (2, 2), (3, 3), (4, 3), (5, 4)):
+        g = ReplicatedSequencerGroup(
+            str(tmp_path / f"g{n_followers}"),
+            n_followers=n_followers)
+        assert g.quorum == want, (n_followers, g.quorum)
+        assert 2 * g.quorum > 1 + n_followers, "strict majority"
+
+
+def test_failover_refused_while_lease_live(tmp_path):
+    g, clock = _group(tmp_path)
+    c = _load_writer(g)
+    _drive(c, 1)  # renews on the replication heartbeat
+    with pytest.raises(LeaseHeldError):
+        g.failover()
+    c.close()
+
+
+# ----------------------------------------------------------------------
+# O(1) sequencer fast-forward (promotion used to pay O(log))
+
+
+def test_sequencer_fast_forward_equals_noop_walk():
+    from fluidframework_tpu.protocol.messages import MessageType
+    from fluidframework_tpu.service.sequencer import DocumentSequencer
+
+    a = DocumentSequencer("d")
+    b = DocumentSequencer("d")
+    for _ in range(7):
+        b.system_message(MessageType.NO_OP, None)
+    a.fast_forward(7)
+    assert a.sequence_number == b.sequence_number == 7
+    assert a.minimum_sequence_number == b.minimum_sequence_number
+    a.fast_forward(3)  # never regresses
+    assert a.sequence_number == 7
+
+
+# ----------------------------------------------------------------------
+# partitioned-plane counterparts
+
+
+def test_replicated_queue_promotes_follower_root(tmp_path):
+    from fluidframework_tpu.service.partitioning import (
+        FileOrderingQueue,
+        ReplicatedFileOrderingQueue,
+    )
+
+    roots = [str(tmp_path / n) for n in ("lead", "f1", "f2")]
+    q = ReplicatedFileOrderingQueue(roots[0], 2, roots[1:])
+    assert q.fsync and all(f.fsync for f in q.followers), (
+        "the quorum claim is only as strong as each node's own "
+        "write barrier")
+    for i in range(6):
+        q.produce(i % 2, f"doc{i % 2}", {"v": i})
+    q.commit(0, 1)
+    q.commit(1, 2)
+    # promotion anti-entropies the best follower root against every
+    # peer, then resumes at the replicated head + mirrored commit
+    promoted = ReplicatedFileOrderingQueue.promote(roots[1:], 2)
+    assert isinstance(promoted, FileOrderingQueue)
+    assert promoted.committed(0) == 1
+    assert promoted.committed(1) == 2
+    assert [r.payload["v"] for r in promoted.read(0, 0)] == [0, 2, 4]
+    tail = [r.payload["v"] for r in promoted.read(
+        0, promoted.committed(0) + 1)]
+    assert tail == [4], "resume exactly past the replicated commit"
+
+
+def test_replicated_queue_survives_dropped_acks(tmp_path):
+    from fluidframework_tpu.service.partitioning import (
+        FileOrderingQueue,
+        ReplicatedFileOrderingQueue,
+    )
+
+    roots = [str(tmp_path / n) for n in ("lead", "f1", "f2")]
+    q = ReplicatedFileOrderingQueue(roots[0], 1, roots[1:])
+    PLANE.site("repl.append_ack").push(KIND_DROP, 4)  # both, twice
+    q.produce(0, "d", {"v": 0})  # quorum must BLOCK and force-sync
+    q.produce(0, "d", {"v": 1})
+    heads = [FileOrderingQueue(r, 1)._counts[0] for r in roots[1:]]
+    assert max(heads) == 2, "quorum needs one whole follower"
+    # and promotion must land on the TRUE replicated head even when
+    # the drop left one follower root lagging — anti-entropy, not
+    # "serve whichever root you grabbed"
+    promoted = ReplicatedFileOrderingQueue.promote(roots[1:], 1)
+    assert promoted._counts[0] == 2
+    assert [r.payload["v"] for r in promoted.read(0, 0)] == [0, 1]
+
+
+def test_replicated_queue_and_checkpoint_fence(tmp_path):
+    from fluidframework_tpu.service.partitioning import (
+        ReplicatedCheckpointManager,
+        ReplicatedFileOrderingQueue,
+    )
+
+    fence = EpochFence(1)
+    roots = [str(tmp_path / n) for n in ("lead", "f1")]
+    q = ReplicatedFileOrderingQueue(roots[0], 1, roots[1:],
+                                    fence=fence, epoch=1)
+    q.produce(0, "d", {"v": 0})
+    ckpt = ReplicatedCheckpointManager(q, 0, fence, 1)
+    ckpt.starting(0)
+    ckpt.completed(0)
+    assert q.committed(0) == 0
+    # promotion THROUGH the shared fence IS the deposition — no
+    # separate advance() for callers to forget
+    ReplicatedFileOrderingQueue.promote(roots[1:], 1, fence=fence)
+    with pytest.raises(FencedWriteError):
+        q.produce(0, "d", {"v": 1})
+    with pytest.raises(FencedWriteError):
+        q.commit(0, 5)
+    ckpt.starting(1)
+    with pytest.raises(FencedWriteError):
+        ckpt.completed(1)
+    assert q.committed(0) == 0, (
+        "a deposed consumer must not move the committed offset")
+    # without a shared fence, fencing is explicitly OFF (a private
+    # default fence would READ as protection while providing none)
+    q2 = ReplicatedFileOrderingQueue(
+        str(tmp_path / "lead2"), 1, [str(tmp_path / "f2")])
+    assert q2.fence is None
+    q2.produce(0, "d", {"v": 0})
