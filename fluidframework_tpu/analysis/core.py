@@ -200,7 +200,7 @@ def load_allowlist(path: str = ALLOWLIST_PATH) -> list[tuple[str, str]]:
 
 
 FAMILIES = ("layercheck", "jaxhazards", "lockcheck", "obscheck",
-            "qoscheck", "concheck", "shapecheck")
+            "qoscheck", "concheck", "shapecheck", "detcheck")
 
 # rule id -> owning family: tooling that groups ONE combined run's
 # findings per family (bench's fluidlint_findings records) reads
@@ -220,6 +220,8 @@ FAMILY_RULES = {
     "shapecheck": ("donated-buffer-reuse", "unladdered-jit-shape",
                    "kernel-dtype-widen", "shape-mismatch",
                    "prewarm-coverage"),
+    "detcheck": ("wall-clock-unrouted", "unseeded-rng",
+                 "iteration-order-leak", "hash-order-dependence"),
 }
 RULE_FAMILY = {
     rule: fam for fam, rules in FAMILY_RULES.items() for rule in rules
@@ -235,6 +237,7 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
     choice — the CLI and gate apply it, tooling may want raw)."""
     from . import (
         concurrency,
+        determinism,
         jaxhazards,
         layercheck,
         lockcheck,
@@ -251,6 +254,7 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
         "qoscheck": qoscheck.check,
         "concheck": concurrency.check,
         "shapecheck": shapecheck.check,
+        "detcheck": determinism.check,
     }
     unknown = [f for f in families if f not in passes]
     if unknown:
@@ -260,10 +264,11 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
     files = walk_python_files(roots, repo_root)
     findings: list[Finding] = []
     by_path = {f.relpath: f for f in files}
-    # one shared call graph per run: jaxhazards, concheck and
-    # shapecheck resolve through the same interprocedural edges (and
-    # pay for the build once)
-    GRAPH_FAMILIES = ("jaxhazards", "concheck", "shapecheck")
+    # one shared call graph per run: jaxhazards, concheck, shapecheck
+    # and detcheck resolve through the same interprocedural edges
+    # (and pay for the build once)
+    GRAPH_FAMILIES = ("jaxhazards", "concheck", "shapecheck",
+                      "detcheck")
     shared_graph = None
     if set(GRAPH_FAMILIES) & set(families):
         from .callgraph import build_callgraph

@@ -9,15 +9,70 @@ runtime/op_lifecycle.py).
 """
 from __future__ import annotations
 
+import os
 import random
 import time
 from typing import Any, Callable, Optional, TypeVar
 
 T = TypeVar("T")
 
-# module-level source for callers that don't inject their own; tests
-# and the loader pass a seeded random.Random for determinism
-_RNG = random.Random()
+
+def default_seed() -> int:
+    """The process's jitter seed: ``FFTPU_SEED`` when set (replaying a
+    failure), otherwise fresh OS entropy — but always an EXPLICIT,
+    recorded value, so a failing jittered-backoff schedule is
+    reproducible by re-running with ``FFTPU_SEED=<printed seed>``."""
+    env = os.environ.get("FFTPU_SEED")
+    if env is not None:
+        try:
+            # base 0: accepts the decimal form JITTER_SEED prints and
+            # pasted hex ("0x1f") alike
+            return int(env, 0)
+        except ValueError:
+            raise ValueError(
+                f"FFTPU_SEED must be an integer, got {env!r}"
+            ) from None
+    return int.from_bytes(os.urandom(4), "big")
+
+
+#: the seed behind the module RNG; set FFTPU_SEED to pin it. Noted
+#: ONCE on stderr at the first module-RNG jitter draw (the moment a
+#: schedule starts mattering), so a flaky backoff failure always has
+#: the seed in its captured output
+JITTER_SEED = default_seed()
+
+# module-level source for callers that don't inject their own
+# (``run_with_retry(rng=...)`` overrides per call); seeded from
+# JITTER_SEED so the backoff schedule is replayable from its seed
+_RNG = random.Random(JITTER_SEED)
+
+_SEED_NOTED = False
+
+
+def _note_seed_once() -> None:
+    global _SEED_NOTED
+    if not _SEED_NOTED:
+        _SEED_NOTED = True
+        import sys
+
+        print(
+            f"driver_utils: jitter seed {JITTER_SEED} "
+            f"(FFTPU_SEED={JITTER_SEED} replays this process's "
+            "backoff schedules)",
+            file=sys.stderr,
+        )
+
+
+def derived_seed(index: int) -> int:
+    """A per-client seed derived from the recorded process seed:
+    distinct streams per client (jitter must decorrelate clients)
+    that all replay from the ONE surfaced ``FFTPU_SEED`` given the
+    same construction order (the loader's backoff RNG uses this).
+    Deriving a stream is the moment a schedule starts mattering, so
+    the process seed is noted here too — a throttle-storm flake whose
+    only jitter rode derived streams still carries its seed."""
+    _note_seed_once()
+    return (JITTER_SEED << 20) ^ index
 
 
 class RetriableError(Exception):
@@ -46,6 +101,8 @@ def full_jitter_delay(attempt: int, *,
     return at floor+base, floor+2*base, ... in lockstep, re-creating
     the spike the throttle shed (the thundering herd)."""
     span = min(max_delay_s, base_delay_s * (2 ** max(0, attempt - 1)))
+    if rng is None:
+        _note_seed_once()
     return max(0.0, floor_s) + (rng or _RNG).uniform(0.0, span)
 
 
@@ -62,7 +119,14 @@ def run_with_retry(fn: Callable[[], T], *,
     """driver-utils runWithRetry: call ``fn`` until it succeeds or a
     non-retriable error/exhaustion; full-jitter exponential backoff
     (:func:`full_jitter_delay`) with a throttler's
-    ``retry_after_seconds`` as the floor."""
+    ``retry_after_seconds`` as the floor.
+
+    ``rng=None`` (the default) draws jitter from the module RNG,
+    which is seeded with :data:`JITTER_SEED` (``FFTPU_SEED`` when
+    set): the whole process's backoff schedule replays from one
+    recorded seed. Pass a dedicated seeded ``random.Random`` to pin
+    one caller's schedule independently of everything else drawing
+    from the shared stream."""
     attempt = 0
     while True:
         try:

@@ -9,6 +9,11 @@ tracker watches processed ops and emits a NO_OP whenever this client
 has seen ``max_unacked_ops`` sequenced ops without telling the service
 (or, via ``tick()``, when it has been idle ``idle_s`` wall seconds with
 any unacknowledged advance).
+
+The clock is injectable (``clock=`` defaulting to ``time.monotonic``,
+the qos/slo idiom): idle-expiry is part of the replay contract —
+detcheck's ``wall-clock-unrouted`` rule keeps a raw ``time.*`` read
+from creeping back in.
 """
 from __future__ import annotations
 
@@ -21,19 +26,21 @@ class CollabWindowTracker:
     ``noopCountFrequency=0`` config); ``tick()`` stays available."""
 
     def __init__(self, submit_noop: Callable[[], None],
-                 max_unacked_ops: int = 50, idle_s: float = 2.0):
+                 max_unacked_ops: int = 50, idle_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic):
         self._submit_noop = submit_noop
         self.max_unacked_ops = max_unacked_ops
         self.idle_s = idle_s
+        self._clock = clock
         self._last_sent_refseq = 0
         self._unacked_ops = 0
-        self._last_activity = time.monotonic()
+        self._last_activity = self._clock()
 
     def on_op_sent(self, refseq: int) -> None:
         """Any outbound message carries our refSeq — heartbeat covered."""
         self._last_sent_refseq = max(self._last_sent_refseq, refseq)
         self._unacked_ops = 0
-        self._last_activity = time.monotonic()
+        self._last_activity = self._clock()
 
     def on_op_processed(self, seq: int) -> None:
         """Called per processed *runtime* op from another client (the
@@ -51,7 +58,7 @@ class CollabWindowTracker:
         ``idle_s``. Returns True if a heartbeat went out."""
         if (
             current_seq > self._last_sent_refseq
-            and time.monotonic() - self._last_activity >= self.idle_s
+            and self._clock() - self._last_activity >= self.idle_s
         ):
             self._heartbeat(current_seq)
             return True
@@ -61,4 +68,4 @@ class CollabWindowTracker:
         self._submit_noop()
         self._last_sent_refseq = seq
         self._unacked_ops = 0
-        self._last_activity = time.monotonic()
+        self._last_activity = self._clock()

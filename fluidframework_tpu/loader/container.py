@@ -15,12 +15,13 @@ ContainerRuntime, and stamps outbound ops with csn/refSeq.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import random
 import time
 from typing import Any, Optional
 
 from ..drivers.definitions import DocumentService
-from ..drivers.driver_utils import full_jitter_delay
+from ..drivers.driver_utils import derived_seed, full_jitter_delay
 from ..models import default_registry
 from ..obs import metrics as obs_metrics
 from ..obs import register_closeable
@@ -38,6 +39,10 @@ from ..runtime import ChannelRegistry, ContainerRuntime
 from ..utils.events import EventEmitter
 from .collab_window import CollabWindowTracker
 from .scheduler import DeltaScheduler, ScheduleManager
+
+# per-process construction ordinal feeding derived_seed: container
+# backoff streams are distinct but replay together from FFTPU_SEED
+_CONTAINER_COUNTER = itertools.count()
 
 _OPS_SUBMITTED = obs_metrics.REGISTRY.counter(
     "container_ops_submitted_total",
@@ -117,7 +122,13 @@ class Container(EventEmitter):
         self._throttled_until = 0.0
         self._throttle_strikes = 0
         self._backoff_clock = time.monotonic
-        self._backoff_rng = random.Random()
+        # derived from the ONE surfaced process jitter seed
+        # (FFTPU_SEED pins it): distinct stream per container (jitter
+        # must decorrelate clients) but a throttle-storm schedule
+        # still replays from the single recorded seed given the same
+        # construction order
+        self._backoff_seed = derived_seed(next(_CONTAINER_COUNTER))
+        self._backoff_rng = random.Random(self._backoff_seed)
         # msn heartbeats for idle clients (collabWindowTracker.ts);
         # noopCountFrequency=0 disables count-based heartbeats
         noop_every = self.mc.config.get_number("noopCountFrequency")

@@ -70,8 +70,13 @@ class DeltaScheduler:
 
     DEFAULT_SLICE_S = 0.05  # the reference's 50ms (deltaScheduler.ts:33)
 
-    def __init__(self, process_one: Callable[[SequencedMessage], None]):
+    def __init__(self, process_one: Callable[[SequencedMessage], None],
+                 clock: Callable[[], float] = time.monotonic):
         self._process_one = process_one
+        # injectable (the qos/slo idiom): slice deadlines are part of
+        # the replay contract, so tests drive them on a manual clock
+        # and detcheck keeps raw time.* reads out of drain()
+        self._clock = clock
         self._queue: list[list[SequencedMessage]] = []
 
     def enqueue(self, unit: list[SequencedMessage]) -> None:
@@ -89,7 +94,7 @@ class DeltaScheduler:
         """Process units until the budget runs out (None = no budget).
         Returns messages processed."""
         deadline = (
-            None if slice_s is None else time.monotonic() + slice_s
+            None if slice_s is None else self._clock() + slice_s
         )
         done = 0
         while self._queue:
@@ -97,6 +102,6 @@ class DeltaScheduler:
             for msg in unit:  # a batch applies atomically
                 self._process_one(msg)
                 done += 1
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and self._clock() >= deadline:
                 break
         return done

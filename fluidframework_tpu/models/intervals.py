@@ -315,8 +315,11 @@ class IntervalCollection:
         already incorporated every remote edit seen while offline."""
         out: list[IntervalOp] = []
         # un-acked deletes resubmit first: peers must stop tracking
-        # the interval regardless of what else changed
-        for interval_id in self._pending_deletes:
+        # the interval regardless of what else changed. Sorted: the
+        # pending set's iteration order is per-process
+        # (PYTHONHASHSEED), and these ops go on the wire — reconnect
+        # resubmission must be byte-identical run to run
+        for interval_id in sorted(self._pending_deletes):
             out.append(IntervalOp(
                 label=self.label, action="delete",
                 interval_id=interval_id,

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import ctypes
 import time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from ..protocol.messages import (
     ClientDetail,
@@ -36,7 +36,8 @@ class NativeSequencerCore:
 
     def __init__(self, document_id: str = "",
                  sequence_number: int = 0,
-                 minimum_sequence_number: int = 0):
+                 minimum_sequence_number: int = 0,
+                 clock: Optional[Callable[[], float]] = None):
         from . import load_native_sequencer
         lib = load_native_sequencer()
         if lib is None:
@@ -45,6 +46,9 @@ class NativeSequencerCore:
                 f"native sequencer unavailable: {native_build_error()}"
             )
         self._lib = lib
+        # same injectable wall clock as DocumentSequencer: wire
+        # timestamps stay byte-stable under a manual clock
+        self._clock = clock or time.time
         self.document_id = document_id
         self._handle = lib.seq_create(
             sequence_number, minimum_sequence_number
@@ -97,7 +101,7 @@ class NativeSequencerCore:
             reference_sequence_number=-1,
             type=msg_type,
             contents=contents,
-            timestamp=time.time(),
+            timestamp=self._clock(),
         )
 
     # ------------------------------------------------------------------
@@ -148,7 +152,7 @@ class NativeSequencerCore:
             out_seq, out_msn, out_status,
         )
         results: list[TicketResult] = []
-        now = time.time()
+        now = self._clock()
         # nacks report the doc seq AT rejection time, matching the
         # sequential oracle: track it through the batch
         running_seq = self.sequence_number - sum(
@@ -255,11 +259,14 @@ class NativeSequencerCore:
         }
 
     @classmethod
-    def restore(cls, state: dict[str, Any]) -> "NativeSequencerCore":
+    def restore(cls, state: dict[str, Any],
+                clock: Optional[Callable[[], float]] = None,
+                ) -> "NativeSequencerCore":
         core = cls(
             document_id=state["document_id"],
             sequence_number=state["sequence_number"],
             minimum_sequence_number=state["minimum_sequence_number"],
+            clock=clock,
         )
         for c in state["clients"]:
             core._lib.seq_restore_client(

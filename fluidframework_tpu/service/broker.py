@@ -81,7 +81,11 @@ class BrokerServer:
         # as the loop used to.
         self._state_lock = threading.Lock()
         self._server: Optional[asyncio.base_events.Server] = None
-        self._writers: set[asyncio.StreamWriter] = set()
+        # dict-as-ordered-set: connection order is deterministic per
+        # run, so shutdown fan-out (and any future broadcast) walks a
+        # stable order — a plain set iterates per-process
+        # (PYTHONHASHSEED), the detcheck iteration-order-leak hazard
+        self._writers: dict[asyncio.StreamWriter, None] = {}
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -110,7 +114,7 @@ class BrokerServer:
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
-        self._writers.add(writer)
+        self._writers[writer] = None
         loop = asyncio.get_running_loop()
         try:
             while True:
@@ -130,7 +134,7 @@ class BrokerServer:
                 writer.write(pack_frame(resp))
                 await writer.drain()
         finally:
-            self._writers.discard(writer)
+            self._writers.pop(writer, None)
             try:
                 writer.close()
                 await writer.wait_closed()

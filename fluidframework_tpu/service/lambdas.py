@@ -87,11 +87,16 @@ class OpLog:
 
 
 class ScriptoriumLambda:
-    def __init__(self, op_log: OpLog):
+    def __init__(self, op_log: OpLog, clock=None):
         self.op_log = op_log
+        # injectable wall clock for the hop stamp that PERSISTS with
+        # the op (the log is a recorded corpus: on a manual clock it
+        # must be byte-stable); None = stamp() wall default
+        self._clock = clock
 
     def handler(self, msg: SequencedMessage) -> None:
-        _stamp(msg.traces, "scriptorium", "write")
+        _stamp(msg.traces, "scriptorium", "write",
+               timestamp=self._clock() if self._clock else None)
         _OPLOG_WRITES.inc()
         self.op_log.append(msg)
 
@@ -128,8 +133,9 @@ class CopierLambda:
 class BroadcasterLambda:
     """broadcaster/lambda.ts:49 — per-document fan-out."""
 
-    def __init__(self) -> None:
+    def __init__(self, clock=None) -> None:
         self._subscribers: dict[str, Callable[[SequencedMessage], None]] = {}
+        self._clock = clock
 
     def subscribe(self, subscriber_id: str,
                   handler: Callable[[SequencedMessage], None]) -> None:
@@ -139,7 +145,8 @@ class BroadcasterLambda:
         self._subscribers.pop(subscriber_id, None)
 
     def handler(self, msg: SequencedMessage) -> None:
-        _stamp(msg.traces, "broadcaster", "fanout")
+        _stamp(msg.traces, "broadcaster", "fanout",
+               timestamp=self._clock() if self._clock else None)
         _BROADCASTS.inc()
         for handler in list(self._subscribers.values()):
             handler(msg)
@@ -233,14 +240,16 @@ class ScribeLambda:
 
     def __init__(self, summary_store: SummaryStore,
                  submit_system_op: Callable[[MessageType, Any], None],
-                 op_log: Optional[OpLog] = None):
+                 op_log: Optional[OpLog] = None, clock=None):
         self.protocol = ProtocolOpHandler()
         self.summary_store = summary_store
         self._submit_system_op = submit_system_op
         self._op_log = op_log
+        self._clock = clock
 
     def handler(self, msg: SequencedMessage) -> None:
-        _stamp(msg.traces, "scribe", "process")
+        _stamp(msg.traces, "scribe", "process",
+               timestamp=self._clock() if self._clock else None)
         self.protocol.process_message(msg)
         if msg.type == MessageType.SUMMARIZE:
             self._handle_summarize(msg)

@@ -33,12 +33,17 @@ class LocalOrderer:
 
     def __init__(self, document_id: str, lumberjack=None,
                  storage=None, checkpoint_every: int = 1,
-                 storage_breaker=None, write_fence=None):
+                 storage_breaker=None, write_fence=None,
+                 clock=None):
         import os
 
         from .telemetry import Lumberjack
         self.document_id = document_id
         self.lumberjack = lumberjack or Lumberjack()
+        # injectable wall clock for the sequencer's wire timestamps
+        # (None = real wall time); survives checkpoint restore and
+        # the checkpoint-ahead rebuild below
+        self.clock = clock
         self.storage = storage
         # optional epoch-fence hook (service/replication.py), called
         # with the operation name ("submit"/"connect"/"disconnect" —
@@ -54,19 +59,21 @@ class LocalOrderer:
         self.storage_breaker = storage_breaker
         self.op_log = storage.op_log if storage is not None else OpLog()
         self.summary_store = SummaryStore(storage)
-        self.sequencer = DocumentSequencer(document_id)
+        self.sequencer = DocumentSequencer(document_id, clock=clock)
         if os.environ.get("FFTPU_NATIVE_SEQUENCER") == "1":
             try:
                 from ..native import NativeSequencerCore
-                self.sequencer = NativeSequencerCore(document_id)
+                self.sequencer = NativeSequencerCore(document_id,
+                                                     clock=clock)
             except (RuntimeError, OSError):
                 pass  # toolchain unavailable: Python path stands in
         self._checkpoint_every = checkpoint_every
         self._since_checkpoint = 0
-        self.scriptorium = ScriptoriumLambda(self.op_log)
-        self.broadcaster = BroadcasterLambda()
+        self.scriptorium = ScriptoriumLambda(self.op_log, clock=clock)
+        self.broadcaster = BroadcasterLambda(clock=clock)
         self.scribe = ScribeLambda(
-            self.summary_store, self._submit_system_op, self.op_log
+            self.summary_store, self._submit_system_op, self.op_log,
+            clock=clock,
         )
         # deli out-topic consumers, in order (localOrderer.ts:237)
         self._pipeline: list[Callable[[SequencedMessage], None]] = [
@@ -105,7 +112,8 @@ class LocalOrderer:
                     "discarding it and fast-forwarding from the log",
                     file=sys.stderr,
                 )
-                self.sequencer = type(self.sequencer)(document_id)
+                self.sequencer = type(self.sequencer)(
+                    document_id, clock=clock)
             # ops sequenced after the last checkpoint write (or with a
             # lost/absent checkpoint entirely) are in the durable log;
             # fast-forward the stream position so new tickets continue
@@ -256,7 +264,7 @@ class LocalOrderer:
         # preserve the sequencer implementation (a NativeSequencerCore
         # must not silently degrade to the Python path on restart)
         self.sequencer = type(self.sequencer).restore(
-            state["sequencer"]
+            state["sequencer"], clock=self.clock
         )
         # scribe's replica resumes at the checkpointed stream position
         # (scribe/lambda.ts:108 skips replayed messages below it)

@@ -61,9 +61,13 @@ class LocalServer:
 
     def __init__(self, durable_dir: Optional[str] = None,
                  storage_breaker=None,
-                 checkpoint_every: int = 1) -> None:
+                 checkpoint_every: int = 1,
+                 clock=None) -> None:
         self.documents: dict[str, LocalOrderer] = {}
         self.durable_dir = durable_dir
+        # injectable wall clock threaded into every orderer's
+        # sequencer (wire timestamps); None = real wall time
+        self.clock = clock
         # ONE shared qos.CircuitBreaker across every document's
         # checkpoint writes (they share the disk, so they share the
         # failure domain); None = unguarded, as before
@@ -99,6 +103,7 @@ class LocalServer:
             document_id, storage=self._make_storage(document_id),
             storage_breaker=self.storage_breaker,
             checkpoint_every=self.checkpoint_every,
+            clock=self.clock,
         )
 
     # ------------------------------------------------------------------
@@ -125,9 +130,16 @@ class LocalServer:
             connection_id, lambda msg: conn.on_message and
             conn.on_message(msg)
         )
+        if detail is None:
+            # the join payload's ClientDetail rides the wire: stamp
+            # it from the injected clock when one is set, so recorded
+            # corpora stay byte-stable under a manual clock
+            detail = ClientDetail(
+                client_id, timestamp=self.clock(),
+            ) if self.clock else ClientDetail(client_id)
         if not read_only:
             try:
-                orderer.connect(detail or ClientDetail(client_id))
+                orderer.connect(detail)
             except Exception:
                 # the client's own delivery callback refused the join
                 # (e.g. the loader's unfillable-gap error): unwind the

@@ -564,9 +564,13 @@ class PartitionedOrderingService:
                  copier: Optional[Any] = None,
                  on_nack: Optional[
                      Callable[[str, str, Nack], None]] = None,
-                 storage_breaker: Optional[Any] = None):
+                 storage_breaker: Optional[Any] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.n_partitions = n_partitions
         self.durable_dir = durable_dir
+        # injectable wall clock for every partition sequencer's wire
+        # timestamps; None = real wall time
+        self.clock = clock
         # shared qos.CircuitBreaker across every document's checkpoint
         # writes (same semantics as LocalServer.storage_breaker)
         self.storage_breaker = storage_breaker
@@ -603,7 +607,8 @@ class PartitionedOrderingService:
                 os.path.join(self.durable_dir, "docs", document_id)
             )
         return LocalOrderer(document_id, storage=storage,
-                            storage_breaker=self.storage_breaker)
+                            storage_breaker=self.storage_breaker,
+                            clock=self.clock)
 
     # -- producer side (alfred -> queue) -------------------------------
     def partition_of(self, document_id: str) -> int:
@@ -732,13 +737,13 @@ class PartitionedServer:
     def __init__(self, n_partitions: int = 4,
                  durable_dir: Optional[str] = None,
                  copier=None, queue: Optional[OrderingQueue] = None,
-                 storage_breaker=None):
+                 storage_breaker=None, clock=None):
         import itertools as _it
 
         self.svc = PartitionedOrderingService(
             n_partitions=n_partitions, durable_dir=durable_dir,
             copier=copier, on_nack=self._route_nack, queue=queue,
-            storage_breaker=storage_breaker,
+            storage_breaker=storage_breaker, clock=clock,
         )
         self._nack_routes: dict[tuple[str, str], Any] = {}
         self._conn_counter = _it.count()

@@ -439,6 +439,7 @@ class ReplicatedLocalServer(LocalServer):
             storage_breaker=self.storage_breaker,
             checkpoint_every=self.checkpoint_every,
             write_fence=self._fence_check_for(document_id),
+            clock=self.clock,
         )
 
     def _fence_check_for(self, document_id: str):

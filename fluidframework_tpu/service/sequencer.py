@@ -22,8 +22,8 @@ Responsibilities:
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
-from typing import Any
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
 
 from ..obs import metrics as _metrics
 from ..obs.trace import stamp as _stamp
@@ -55,7 +55,7 @@ class _ClientState:
     reference_sequence_number: int
     client_sequence_number: int = 0
     can_evict: bool = True
-    last_update: float = field(default_factory=time.time)
+    last_update: float = 0.0
 
 
 @dataclass
@@ -78,10 +78,17 @@ class DocumentSequencer:
         document_id: str = "",
         sequence_number: int = 0,
         minimum_sequence_number: int = 0,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self.document_id = document_id
         self.sequence_number = sequence_number
         self.minimum_sequence_number = minimum_sequence_number
+        # injectable wall clock (the qos/slo idiom): the wire-visible
+        # ``timestamp`` stamps and trace hops route through it, so a
+        # recorded corpus replayed under a manual clock is byte-stable
+        # (production default stays real wall time — timestamps on
+        # the wire MEAN server wall time)
+        self._clock = clock or time.time
         self._clients: dict[str, _ClientState] = {}
 
     # ------------------------------------------------------------------
@@ -107,6 +114,7 @@ class DocumentSequencer:
             self._clients[detail.client_id] = _ClientState(
                 client_id=detail.client_id,
                 reference_sequence_number=seq - 1,
+                last_update=self._clock(),
             )
         # A redundant join (at-least-once ingress retry) must NOT reset
         # sequencing state, or replayed ops would be re-ticketed as new.
@@ -173,16 +181,20 @@ class DocumentSequencer:
                 message="refSeq ahead of document sequence number",
             ))
 
+        now = self._clock()
         client.client_sequence_number = op.client_sequence_number
         client.reference_sequence_number = op.reference_sequence_number
-        client.last_update = time.time()
+        client.last_update = now
 
         seq = self._next_seq()
         msn = self._compute_msn()
         _TICKETS.inc()
         # the deli stamp (deli/lambda.ts:1130): the op's client-side
-        # hops travel with it; this marks the ordering authority
-        traces = _stamp(list(op.traces), "sequencer", "ticket")
+        # hops travel with it; this marks the ordering authority.
+        # timestamp= from the injected clock, so the stamp is as
+        # replayable as the message it rides
+        traces = _stamp(list(op.traces), "sequencer", "ticket",
+                        timestamp=now)
         return TicketResult(message=SequencedMessage(
             client_id=client_id,
             sequence_number=seq,
@@ -192,7 +204,7 @@ class DocumentSequencer:
             type=op.type,
             contents=op.contents,
             metadata=op.metadata,
-            timestamp=time.time(),
+            timestamp=now,
             traces=traces,
         ))
 
@@ -227,23 +239,32 @@ class DocumentSequencer:
                     "client_id": c.client_id,
                     "reference_sequence_number": c.reference_sequence_number,
                     "client_sequence_number": c.client_sequence_number,
+                    "last_update": c.last_update,
                 }
                 for c in self._clients.values()
             ],
         }
 
     @classmethod
-    def restore(cls, state: dict[str, Any]) -> "DocumentSequencer":
+    def restore(cls, state: dict[str, Any],
+                clock: Optional[Callable[[], float]] = None,
+                ) -> "DocumentSequencer":
         seq = cls(
             document_id=state["document_id"],
             sequence_number=state["sequence_number"],
             minimum_sequence_number=state["minimum_sequence_number"],
+            clock=clock,
         )
         for c in state["clients"]:
             seq._clients[c["client_id"]] = _ClientState(
                 client_id=c["client_id"],
                 reference_sequence_number=c["reference_sequence_number"],
                 client_sequence_number=c["client_sequence_number"],
+                # diagnostics parity with clientSeqManager (no code
+                # consumes it yet): restored as recorded instead of
+                # re-minted at restore-time, .get-defaulted for
+                # checkpoints written before the field persisted
+                last_update=c.get("last_update", 0.0),
             )
         return seq
 
@@ -281,5 +302,5 @@ class DocumentSequencer:
             reference_sequence_number=-1,
             type=msg_type,
             contents=contents,
-            timestamp=time.time(),
+            timestamp=self._clock(),
         )

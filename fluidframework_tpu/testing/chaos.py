@@ -462,6 +462,10 @@ class ChaosHarness:
                     server_kwargs=dict(
                         checkpoint_every=self.checkpoint_every,
                         storage_breaker=breaker,
+                        # wire timestamps on the step clock: recorded
+                        # corpora (op logs, attribution tables) are
+                        # byte-stable per seed, not per wall time
+                        clock=self.clock,
                     ),
                 )
             local = self.group.server
@@ -470,6 +474,7 @@ class ChaosHarness:
                 durable_dir=self.durable_dir,
                 checkpoint_every=self.checkpoint_every,
                 storage_breaker=breaker,
+                clock=self.clock,
             )
         self.server = AlfredServer(local)
         self._build_sidecar()
@@ -958,7 +963,10 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
     # every run, not just in their unit tests
     from ..service.partitioning import PartitionedOrderingService
 
-    broker = PartitionedOrderingService(n_partitions=1)
+    # step clock for wire timestamps, like the main plane: the broker
+    # leg's sequenced records are part of the per-seed corpus too
+    broker = PartitionedOrderingService(n_partitions=1,
+                                        clock=harness.clock)
     broker.produce_join("chaos-broker", ClientDetail("bk"))
     broker_csn = 0
 

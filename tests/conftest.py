@@ -33,15 +33,20 @@ import pytest  # noqa: E402
 # sanitizer. Installed at conftest import — BEFORE test modules
 # import — so locks created at test-module import time are wrapped
 # too. jitsan (testing/jitsan.py) rides the same guard: it baselines
-# the kernel jit caches and arms the donation read-traps. The autouse
-# guard below fails any test that trips either.
+# the kernel jit caches and arms the donation read-traps. detsan
+# (testing/detsan.py) rides it too: patched time/random entry points
+# trip on un-routed clock reads / unseeded RNG draws inside
+# deterministic-plane components. The autouse guard below fails any
+# test that trips any of the three.
 _SANITIZE = os.environ.get("FFTPU_SANITIZE") == "1"
 if _SANITIZE:
+    from fluidframework_tpu.testing import detsan as _detsan
     from fluidframework_tpu.testing import jitsan as _jitsan
     from fluidframework_tpu.testing import sanitizer as _fluidsan
 
     _fluidsan.install()
     _jitsan.install()
+    _detsan.install()
 
 
 @pytest.fixture(autouse=True)
@@ -49,10 +54,11 @@ def _fluidsan_trip_guard():
     if not _SANITIZE:
         yield
         return
-    from fluidframework_tpu.testing import jitsan, sanitizer
+    from fluidframework_tpu.testing import detsan, jitsan, sanitizer
 
     before = len(sanitizer.trips())
     before_jit = len(jitsan.trips())
+    before_det = len(detsan.trips())
     yield
     fresh = sanitizer.trips()[before:]
     if fresh:
@@ -66,6 +72,13 @@ def _fluidsan_trip_guard():
         pytest.fail(
             "jitsan tripped during this test:\n"
             + "\n".join(t.describe() for t in fresh_jit)
+        )
+    fresh_det = detsan.trips()[before_det:]
+    if fresh_det:
+        pytest.fail(
+            "detsan tripped during this test:\n"
+            + "\n".join(t.describe() for t in fresh_det)
+            + "\n" + fresh_det[0].flight_dump
         )
 
 

@@ -118,3 +118,28 @@ def test_own_ops_count_as_heartbeat():
         sb.insert_text(0, "w")
         b.flush()
     assert submitted == [], "active client emitted needless noops"
+
+
+def test_idle_expiry_on_a_manual_clock():
+    """The clock is injectable (the detcheck wall-clock-unrouted
+    contract): idle-expiry heartbeats are driven entirely by the
+    injected clock, so a test pins the schedule exactly — no real
+    waiting, no wall-clock read."""
+    sent = []
+    t = 0.0
+    tracker = CollabWindowTracker(
+        lambda: sent.append(1), max_unacked_ops=0, idle_s=2.0,
+        clock=lambda: t,
+    )
+    tracker.on_op_sent(3)
+    t = 1.9
+    assert not tracker.tick(9)      # advanced, but not idle enough
+    t = 2.0
+    assert tracker.tick(9)          # exactly idle_s since activity
+    assert sent == [1]
+    # the heartbeat itself counts as activity on the same clock
+    t = 3.9
+    assert not tracker.tick(12)
+    t = 4.0
+    assert tracker.tick(12)
+    assert sent == [1, 1]

@@ -1807,13 +1807,42 @@ def test_finding_keys_are_line_free_across_all_families(tmp_path):
                 bad = jnp.zeros(RAW)
                 return pingpong(bad, batch)
         """,
+        # detcheck: all four determinism rules fire — the root-suffix
+        # module makes its functions deterministic-contract roots,
+        # and the ordinal keys (two raw reads in ticket) must both
+        # survive the line shift
+        "fluidframework_tpu/service/sequencer.py": """
+            import random
+            import time
+
+            class DocumentSequencer:
+                def ticket(self, op, n):
+                    t0 = time.time()
+                    t1 = time.time()
+                    part = hash(op.document_id) % n
+                    jitter = random.uniform(0.0, 1.0)
+                    pending = set(op.targets)
+                    return list(pending), part, t1 - t0, jitter
+        """,
     }
     key_families = ["layercheck", "jaxhazards", "lockcheck",
-                    "qoscheck", "concheck", "shapecheck"]
+                    "qoscheck", "concheck", "shapecheck", "detcheck"]
     baseline = _lint(tmp_path, dict(files), families=key_families)
     assert len(baseline) >= 5
     assert {"donated-buffer-reuse", "unladdered-jit-shape",
             "kernel-dtype-widen"} <= _rules(baseline)
+    assert {"wall-clock-unrouted", "unseeded-rng",
+            "iteration-order-leak",
+            "hash-order-dependence"} <= _rules(baseline)
+    det_keys = sorted(
+        f.key for f in baseline if f.rule == "wall-clock-unrouted")
+    # qualname-ordinal keys: the second raw read in the same scope
+    # gets a distinct, stable suffix (the concheck/shapecheck key
+    # contract)
+    assert det_keys == [
+        "sequencer.py:DocumentSequencer.ticket:time.time",
+        "sequencer.py:DocumentSequencer.ticket:time.time2",
+    ]
     shifted_files = {
         # indentation matches the fixture bodies so dedent still
         # normalizes them; only the line NUMBERS move

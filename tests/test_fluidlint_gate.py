@@ -433,6 +433,242 @@ def test_shapecheck_family_is_in_the_gate():
     assert "shapecheck" in core.FAMILIES
 
 
+def test_detcheck_family_is_in_the_gate():
+    assert "detcheck" in core.FAMILIES
+
+
+def test_wall_clock_unrouted_rule(tmp_path):
+    """detcheck:wall-clock-unrouted — a direct time.* read reachable
+    from a deterministic-contract root (here: a fixture matching the
+    sequencer-root suffix) fails; reads routed through an injected
+    ``clock()`` pass; a fixture matching a WALL_CLOCK_SINKS suffix
+    (obs/trace.py stamp) is a reviewed sink; code NOT reachable from
+    any root is out of the rule's scope."""
+    svc = tmp_path / "service"
+    svc.mkdir()
+    bad = svc / "sequencer.py"
+    bad.write_text(
+        "import time\n"
+        "class DocumentSequencer:\n"
+        "    def __init__(self, clock=None):\n"
+        "        self._clock = clock or time.time\n"
+        "    def ticket(self, op):\n"
+        # the first read is NESTED deeper than the second: ordinals
+        # must still follow SOURCE order, not ast.walk's BFS order
+        "        raw = max(1.0, time.time())\n"            # BAD
+        "        raw2 = time.time()\n"                     # BAD
+        "        routed = self._clock()\n"                 # ok
+        "        return self._stamp(op, raw + raw2 + routed)\n"
+        "    def _stamp(self, op, t):\n"
+        "        return (op, t, time.monotonic())\n"       # BAD
+    )
+    # reads in a module no deterministic root reaches are out of the
+    # rule's scope (reachability IS the scope)
+    (svc / "util.py").write_text(
+        "import time\n"
+        "def helper_not_reachable():\n"
+        "    return time.perf_counter()\n"
+    )
+    findings = core.run_analysis(
+        roots=[str(svc)], families=["detcheck"])
+    assert sorted(f.key for f in findings) == [
+        "sequencer.py:DocumentSequencer._stamp:time.monotonic",
+        "sequencer.py:DocumentSequencer.ticket:time.time",
+        "sequencer.py:DocumentSequencer.ticket:time.time2",
+    ]
+    assert all(f.rule == "wall-clock-unrouted" for f in findings)
+    # ordinal suffixes follow source order: the nested read on the
+    # EARLIER line owns the unsuffixed key
+    by_key = {f.key.rsplit(":", 1)[-1]: f.line for f in findings
+              if "ticket" in f.key}
+    assert by_key["time.time"] < by_key["time.time2"]
+
+    # a registered sink suffix is the reviewed escape hatch
+    obs = tmp_path / "sink" / "obs"
+    obs.mkdir(parents=True)
+    (tmp_path / "sink" / "service").mkdir()
+    (obs / "trace.py").write_text(
+        "import time\n"
+        "def stamp(traces):\n"
+        "    traces.append(time.time())\n"
+    )
+    (tmp_path / "sink" / "service" / "sequencer.py").write_text(
+        "from ..obs.trace import stamp\n"
+        "class DocumentSequencer:\n"
+        "    def ticket(self, traces):\n"
+        "        stamp(traces)\n"
+    )
+    assert core.run_analysis(
+        roots=[str(tmp_path / "sink")], families=["detcheck"],
+    ) == []
+
+
+def test_unseeded_rng_rule(tmp_path):
+    """detcheck:unseeded-rng — unseeded random.Random(), the
+    process-global random.* stream, and seedless np.random draws fail
+    in deterministic-plane components; seeded/injected RNG passes;
+    the same code outside the planes is out of scope."""
+    drv = tmp_path / "drivers"
+    drv.mkdir()
+    bad = drv / "bad.py"
+    bad.write_text(
+        "import random\n"
+        "import numpy as np\n"
+        "_RNG = random.Random()\n"                         # BAD
+        "def jitter():\n"
+        "    return random.uniform(0.0, 1.0)\n"            # BAD
+        "def noise():\n"
+        "    return np.random.rand()\n"                    # BAD
+        "def seeded(seed):\n"
+        "    rng = random.Random(seed)\n"                  # ok
+        "    gen = np.random.default_rng(seed)\n"          # ok
+        "    return rng.random() + gen.random()\n"
+        "def injected(rng):\n"
+        "    return rng.uniform(0.0, 1.0)\n"               # ok
+    )
+    findings = core.run_analysis(
+        roots=[str(bad)], families=["detcheck"])
+    assert sorted(f.key for f in findings) == [
+        "bad.py:<module>:Random",
+        "bad.py:jitter:random.uniform",
+        "bad.py:noise:rand",
+    ]
+    assert all(f.rule == "unseeded-rng" for f in findings)
+
+    other = tmp_path / "elsewhere.py"
+    other.write_text(
+        "import random\n"
+        "x = random.random()\n"
+    )
+    assert core.run_analysis(
+        roots=[str(other)], families=["detcheck"]) == []
+
+
+def test_iteration_order_leak_rule(tmp_path):
+    """detcheck:iteration-order-leak — sets iterated into
+    order-sensitive sinks (fan-out/append loops, list()/tuple()
+    materialization, join) fail; sorted(...) kills the taint;
+    order-insensitive consumption (membership, len, building another
+    set) passes."""
+    svc = tmp_path / "service"
+    svc.mkdir()
+    bad = svc / "bad.py"
+    bad.write_text(
+        "class Fanout:\n"
+        "    def __init__(self):\n"
+        "        self.writers = set()\n"
+        "    def broadcast(self, out, frame):\n"
+        "        for w in self.writers:\n"                 # BAD
+        "            out.append((w, frame))\n"
+        "    def snapshot(self, ids):\n"
+        "        pending = set(ids)\n"
+        "        return list(pending)\n"                   # BAD
+        "    def wire(self, ids):\n"
+        "        return ','.join(set(ids))\n"              # BAD
+        "    def stable(self, ids):\n"
+        "        pending = set(ids)\n"
+        "        for w in sorted(pending):\n"              # ok
+        "            ids.append(w)\n"
+        "        return sorted(self.writers)\n"            # ok
+        "    def insensitive(self, ids):\n"
+        "        pending = set(ids)\n"
+        "        n = len(pending)\n"                       # ok
+        "        return {x for x in pending}, n\n"         # ok
+        # a defect inside a nested def is ONE finding against the
+        # nested scope, not a duplicate against the method too (the
+        # fence-before-fanout nested-gate contract)
+        "    def wrap(self, out):\n"
+        "        def inner(ids):\n"
+        "            pend = set(ids)\n"
+        "            for w in pend:\n"                     # BAD once
+        "                out.append(w)\n"
+        "        return inner\n"
+    )
+    findings = core.run_analysis(
+        roots=[str(bad)], families=["detcheck"])
+    assert sorted(f.key for f in findings) == [
+        "bad.py:Fanout.broadcast:writers",
+        "bad.py:Fanout.snapshot:pending",
+        "bad.py:Fanout.wire:<set>",
+        "bad.py:Fanout.wrap.inner:pend",
+    ]
+    assert all(
+        f.rule == "iteration-order-leak" for f in findings)
+
+
+def test_hash_order_dependence_rule(tmp_path):
+    """detcheck:hash-order-dependence — builtin hash() of str/bytes,
+    and hash(x) %% n partition selection, fail in deterministic
+    planes; __hash__ methods and integer hashing pass."""
+    svc = tmp_path / "service"
+    svc.mkdir()
+    bad = svc / "bad.py"
+    bad.write_text(
+        "class Router:\n"
+        "    def partition(self, doc_id, n):\n"
+        "        return hash(doc_id) % n\n"                # BAD (%)
+        "    def key(self, tenant, doc):\n"
+        "        return hash(f'{tenant}/{doc}')\n"         # BAD (str)
+        "    def __hash__(self):\n"
+        "        return hash(('Router', self.key))\n"      # ok
+        "    def int_ok(self, seq):\n"
+        "        return hash(seq + 1)\n"                   # ok
+    )
+    findings = core.run_analysis(
+        roots=[str(bad)], families=["detcheck"])
+    assert sorted(f.key for f in findings) == [
+        "bad.py:Router.key:hash",
+        "bad.py:Router.partition:hash",
+    ]
+    assert all(
+        f.rule == "hash-order-dependence" for f in findings)
+
+
+def test_detcheck_live_tree_is_clean_with_empty_allowlist():
+    """The acceptance bar (the PR1/PR5/PR7 precedent): zero live
+    detcheck findings over the whole repo and NOTHING grandfathered —
+    the sites the family found live (driver_utils' module RNG, the
+    collab-window/scheduler clocks, the sequencer wire timestamps,
+    the broker writer set, the interval pending-delete resubmission)
+    were FIXED in the PR that introduced it. WALL_CLOCK_SINKS is the
+    reviewed escape hatch, not the allowlist."""
+    kept, _stale, allowlist = _gate()
+    det_rules = set(core.FAMILY_RULES["detcheck"])
+    det_kept = [f for f in kept if f.rule in det_rules]
+    assert det_kept == [], \
+        "\n".join(f.format() for f in det_kept)
+    grandfathered = [e for e in allowlist if e[0] in det_rules]
+    assert grandfathered == [], (
+        "detcheck findings must be fixed, never grandfathered: "
+        f"{grandfathered}"
+    )
+
+
+def test_wall_clock_sinks_registry_resolves_to_live_sites():
+    """Registry non-vacuity (the FANOUT_GATES contract): every
+    WALL_CLOCK_SINKS entry must still name a function (or module)
+    containing a real wall-clock call — a stale entry fails HERE so
+    the registry can only describe live code."""
+    from fluidframework_tpu.analysis import determinism
+
+    files = core.walk_python_files(["fluidframework_tpu"])
+    stale = determinism.stale_wall_clock_sinks(files)
+    assert stale == [], (
+        "stale WALL_CLOCK_SINKS entries (no wall-clock call at the "
+        f"registered site anymore — delete them): {stale}"
+    )
+    assert determinism.WALL_CLOCK_SINKS, "registry unexpectedly empty"
+
+    # the staleness detector itself is not vacuous
+    ghost = ("service/sequencer.py", "DocumentSequencer.ticket")
+    assert ghost not in determinism.WALL_CLOCK_SINKS
+    try:
+        determinism.WALL_CLOCK_SINKS[ghost] = "test-only ghost entry"
+        assert ghost in determinism.stale_wall_clock_sinks(files)
+    finally:
+        del determinism.WALL_CLOCK_SINKS[ghost]
+
+
 def test_family_rules_map_stays_complete():
     """RULE_FAMILY is how one combined run's findings group per
     family (bench records); a family missing from the map would
@@ -445,7 +681,9 @@ def test_family_rules_map_stays_complete():
                  "async-blocking-call", "await-holding-lock",
                  "dispatch-loop-sync", "donated-buffer-reuse",
                  "unladdered-jit-shape", "kernel-dtype-widen",
-                 "shape-mismatch", "prewarm-coverage"):
+                 "shape-mismatch", "prewarm-coverage",
+                 "wall-clock-unrouted", "unseeded-rng",
+                 "iteration-order-leak", "hash-order-dependence"):
         assert rule in core.RULE_FAMILY, rule
 
 
@@ -483,7 +721,7 @@ def test_shapecheck_live_tree_is_clean_within_the_ratchet():
 
 
 def test_combined_gate_run_stays_under_budget():
-    """The CI/tooling satellite: seven families, one shared
+    """The CI/tooling satellite: eight families, one shared
     callgraph, one budget. A blowup here means a family stopped
     reusing the per-run graph or a fixpoint regressed superlinear."""
     _gate()  # ensures the timed run happened (memoized per session)

@@ -181,3 +181,57 @@ def test_record_and_replay_roundtrip(tmp_path):
                               connect=False)
     # replay catch-up happens via read_ops during load
     assert text_of(replayed) == expected
+
+
+def test_server_minted_corpus_is_byte_stable_on_a_manual_clock():
+    """The whole server pipeline routes its wire timestamps through
+    the injected clock (sequencer ticket/system stamps, join
+    ClientDetail, scriptorium/scribe/broadcaster hop stamps): two
+    identical sessions on the same manual clock record byte-identical
+    op logs, modulo the client/driver hop stamps minted by the OTHER
+    process's clock (the detcheck wall-clock-unrouted contract)."""
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.protocol.serialization import (
+        message_to_json,
+    )
+
+    def strip_client_hops(rec):
+        rec = dict(rec)
+        rec["traces"] = [
+            t for t in rec.get("traces") or []
+            if t["service"] not in ("client", "driver")
+        ]
+        return rec
+
+    def session():
+        t = {"v": 1000.0}
+
+        def clock():
+            t["v"] += 0.5
+            return t["v"]
+
+        server = LocalServer(clock=clock)
+        factory = LocalDocumentServiceFactory(server)
+        a = Container.load(factory.create_document_service("doc"),
+                           client_id="alice")
+        b = Container.load(factory.create_document_service("doc"),
+                           client_id="bob")
+        sa = a.runtime.create_datastore("d").create_channel(
+            "sharedstring", "t")
+        a.flush()
+        sb = b.runtime.get_datastore("d").get_channel("t")
+        sa.insert_text(0, "hello ")
+        a.flush()
+        sb.insert_text(6, "world")
+        b.flush()
+        assert sa.get_text() == sb.get_text() == "hello world"
+        return [message_to_json(m)
+                for m in server.get_orderer("doc").op_log.read(0)]
+
+    s1, s2 = session(), session()
+    assert [strip_client_hops(m) for m in s1] == \
+        [strip_client_hops(m) for m in s2]
+    for rec in s1:
+        assert 1000.0 < rec["timestamp"] < 2000.0
+        for t in strip_client_hops(rec)["traces"]:
+            assert 1000.0 < t["timestamp"] < 2000.0, t

@@ -200,3 +200,27 @@ def test_delta_scheduler_batch_is_atomic_across_slices():
     assert ds.pending_units == 1
     ds.drain()
     assert processed == [1, 2, 3]
+
+
+def test_delta_scheduler_slice_deadline_on_a_manual_clock():
+    """The slice budget runs on the injected clock (the detcheck
+    wall-clock-unrouted contract): a deadline mid-queue yields
+    between units deterministically, with no wall-clock read."""
+    t = {"v": 0.0}
+    processed = []
+
+    def tick_process(m):
+        processed.append(m.sequence_number)
+        t["v"] += 0.03            # each message costs 30 simulated ms
+
+    ds = DeltaScheduler(tick_process, clock=lambda: t["v"])
+    ds.enqueue([seqmsg(1), seqmsg(2)])
+    ds.enqueue([seqmsg(3)])
+    ds.enqueue([seqmsg(4)])
+    # 50ms budget: unit one (60ms, atomic) overruns the deadline ->
+    # yield; units two and three wait for the next slice
+    assert ds.drain(slice_s=0.05) == 2
+    assert processed == [1, 2]
+    assert ds.pending_units == 2
+    assert ds.drain(slice_s=0.05) == 2
+    assert processed == [1, 2, 3, 4]

@@ -262,3 +262,22 @@ def test_durable_layout_marker_refuses_mismatch(tmp_path):
     with pytest.raises(SystemExit, match="refusing to start"):
         _check_durable_layout(d, partitions=0)
     _check_durable_layout(None, partitions=2)  # non-durable: no-op
+
+
+def test_partitioned_wire_timestamps_ride_the_injected_clock():
+    """The clock threads down to every partition sequencer (the
+    detcheck wall-clock-unrouted contract): records sequenced through
+    the partitioned pipeline carry manual-clock timestamps, so the
+    broker-leg corpus is byte-stable per seed like the main plane."""
+    t = {"v": 500.0}
+
+    def clock():
+        t["v"] += 0.25
+        return t["v"]
+
+    svc = PartitionedOrderingService(n_partitions=2, clock=clock)
+    svc.produce_join("doc", ClientDetail(client_id="alice"))
+    svc.produce_op("doc", "alice", op(1))
+    assert svc.pump() == 2
+    msgs = svc.orderer("doc").op_log.read(0)
+    assert msgs and all(500.0 < m.timestamp < 600.0 for m in msgs)
