@@ -2127,8 +2127,11 @@ def stage_config12(scale: str, reps: int, cooldown: float) -> dict:
     docs/ROBUSTNESS.md "Replication & failover"): the config11 storm
     over the REPLICATED plane with the leader KILLED mid-storm —
     reporting ``failover_time_s`` (step clock from host loss to the
-    first post-failover ack) and ``repl_lag_max`` next to
-    ``goodput_dip``/``recovery_time_s``, x2 runs bit-equal. A
+    first post-failover ack, measured off the fleet timeline) DECOMPOSED
+    into ``failover_phases`` (detection / anti-entropy / promotion /
+    first-ack — must sum to within one step of the headline number),
+    the federated ``fleet_metrics`` snapshot, and ``repl_lag_max``
+    next to ``goodput_dip``/``recovery_time_s``, x2 runs bit-equal. A
     convergence leg runs the kill-the-leader differential (one seed
     per enumerated kill mode: mid-batch, promotion under replication
     lag, deposed-leader fenced write) against the fault-free oracle
@@ -2157,6 +2160,18 @@ def stage_config12(scale: str, reps: int, cooldown: float) -> dict:
     assert storm_rep.failover_time_s is not None and \
         storm_rep.failovers >= 1, (
             "config12's leader kill never failed over")
+    # the causal decomposition (obs/timeline.py): the four phases
+    # must reconcile with the headline number to within one step
+    phases = storm_rep.failover_phases
+    assert phases is not None, "kill ran but no failover_phases"
+    phase_sum = (phases["detection_s"] + phases["anti_entropy_s"]
+                 + phases["promotion_s"] + phases["first_ack_s"])
+    assert abs(phase_sum - storm_rep.failover_time_s) <= 0.05 + 1e-9, (
+        f"config12 failover_phases sum {phase_sum} does not "
+        f"reconcile with failover_time_s "
+        f"{storm_rep.failover_time_s} (phases: {phases})")
+    assert storm_rep.fleet_metrics, (
+        "config12 storm produced no federated fleet snapshot")
     again = run_chaos_storm(seed=12, steps=steps, storm=storm,
                             kill_leader_step=kill_step)
     assert again.deterministic_fields() == \
@@ -2202,6 +2217,8 @@ def stage_config12(scale: str, reps: int, cooldown: float) -> dict:
         "storm_window": list(storm),
         "kill_leader_step": kill_step,
         "failover_time_s": storm_rep.failover_time_s,
+        "failover_phases": storm_rep.failover_phases,
+        "fleet_metrics": storm_rep.fleet_metrics,
         "failovers": storm_rep.failovers,
         "repl_lag_max": storm_rep.repl_lag_max,
         "offered_ops": storm_rep.offered_ops,

@@ -219,6 +219,69 @@ def _check_objectives(src: SourceFile, aliases: dict,
             ))
 
 
+def _hop_literals(tree: ast.AST, aliases: dict):
+    """Yield ``(service, action, lineno)`` for every ``stamp(...)``
+    call and direct ``Trace(...)`` construction whose service/action
+    are string literals — the shared extraction behind the
+    obs-untimed-hop rule AND the canonical-table staleness check
+    (:func:`stale_canonical_hops`). Dynamic arguments are skipped:
+    the runtime ValueError covers them."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func, aliases)
+        if dotted is None:
+            continue
+        if _matches_suffix(dotted, _STAMP_SUFFIXES):
+            # stamp(traces, service, action, ...)
+            args = node.args[1:3]
+        elif _matches_suffix(dotted, _TRACE_SUFFIXES):
+            # Trace(service, action, ...) — keyword form included
+            args = list(node.args[:2])
+            kw = {k.arg: k.value for k in node.keywords}
+            while len(args) < 2:
+                name = ("service", "action")[len(args)]
+                if name not in kw:
+                    break
+                args.append(kw[name])
+        else:
+            continue
+        if len(args) < 2:
+            continue
+        service = _literal_str(args[0])
+        action = _literal_str(args[1])
+        if service is None or action is None:
+            continue
+        yield service, action, node.lineno
+
+
+def collect_stamped_hops(files: list[SourceFile]) -> set[tuple]:
+    """Every (service, action) pair stamped with literals anywhere in
+    ``files`` — the live-call-site universe the staleness check
+    compares the canonical table against."""
+    out: set[tuple] = set()
+    for src in files:
+        if src.tree is None or src.relpath.endswith("obs/trace.py"):
+            continue
+        aliases = _import_aliases(src.tree)
+        for service, action, _lineno in _hop_literals(src.tree,
+                                                      aliases):
+            out.add((service, action))
+    return out
+
+
+def stale_canonical_hops(files: list[SourceFile],
+                         hops: Optional[set] = None) -> list[tuple]:
+    """CANONICAL_HOPS entries no real ``stamp()``/``Trace()`` call
+    site reaches — ghost vocabulary (the WALL_CLOCK_SINKS staleness
+    contract): every hop the table registers must be stamped
+    somewhere in the live tree, or breakdowns/dashboards group on a
+    name nothing ever emits. The gate test asserts this is empty."""
+    if hops is None:
+        hops = load_canonical_hops()
+    return sorted(hops - collect_stamped_hops(files))
+
+
 def check(files: list[SourceFile]) -> list[Finding]:
     hops = load_canonical_hops()
     registered = collect_registrations(files)
@@ -234,36 +297,12 @@ def check(files: list[SourceFile]) -> list[Finding]:
             # construct no live objectives)
             _check_objectives(src, aliases, registered, findings)
         module = src.relpath.rsplit("/", 1)[-1]
-        for node in ast.walk(src.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            dotted = _dotted(node.func, aliases)
-            if dotted is None:
-                continue
-            if _matches_suffix(dotted, _STAMP_SUFFIXES):
-                # stamp(traces, service, action, ...)
-                args = node.args[1:3]
-            elif _matches_suffix(dotted, _TRACE_SUFFIXES):
-                # Trace(service, action, ...) — keyword form included
-                args = list(node.args[:2])
-                kw = {k.arg: k.value for k in node.keywords}
-                while len(args) < 2:
-                    name = ("service", "action")[len(args)]
-                    if name not in kw:
-                        break
-                    args.append(kw[name])
-            else:
-                continue
-            if len(args) < 2:
-                continue
-            service = _literal_str(args[0])
-            action = _literal_str(args[1])
-            if service is None or action is None:
-                continue  # dynamic: the runtime ValueError covers it
+        for service, action, lineno in _hop_literals(src.tree,
+                                                     aliases):
             if (service, action) not in hops:
                 findings.append(Finding(
                     rule="obs-untimed-hop",
-                    path=src.relpath, line=node.lineno,
+                    path=src.relpath, line=lineno,
                     message=(
                         f"trace hop {service}:{action} is not in the "
                         "canonical hop table (fluidframework_tpu/obs/"

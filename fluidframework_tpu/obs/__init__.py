@@ -21,6 +21,14 @@ Pillars (docs/OBSERVABILITY.md):
   hooks.
 - ``obs.spans`` — the hop tables as OTLP-JSON span trees for
   standard trace viewers.
+- ``obs.federation`` — the FLEET half of the registry: merge leader /
+  follower / partition-worker registries into one federated view
+  (sum counters, node-labelled gauges, bucket-wise histogram merge)
+  served over the ``fleet-metrics`` frame and ``--dump-fleet``.
+- ``obs.timeline`` — the causally-ordered cross-node event log
+  (lease lifecycle, epoch fences, promotions, anti-entropy, mesh
+  migrations) that decomposes failover into named phases and exports
+  the incident as an OTLP span tree.
 
 This package sits just above ``protocol`` in the layer map so every
 other layer may depend on it; it depends on nothing above.
@@ -29,11 +37,18 @@ from __future__ import annotations
 
 import weakref
 
+from .federation import FederatedView
 from .flight_recorder import FlightRecorder
 from .metrics import REGISTRY, MetricsRegistry, get_registry
 from .profiler import ContinuousProfiler, device_trace
 from .slo import Objective, SloEngine
-from .spans import FileSpanExporter, op_to_otlp, otlp_to_hops
+from .spans import (
+    FileSpanExporter,
+    op_to_otlp,
+    otlp_to_hops,
+    timeline_to_otlp,
+)
+from .timeline import TIMELINE_KINDS, FleetTimeline
 from .trace import (
     CANONICAL_HOPS,
     breakdown,
@@ -44,11 +59,13 @@ from .trace import (
 )
 
 __all__ = [
-    "CANONICAL_HOPS", "ContinuousProfiler", "FileSpanExporter",
-    "FlightRecorder", "MetricsRegistry", "Objective", "REGISTRY",
-    "SloEngine", "breakdown", "device_trace", "format_breakdown",
-    "get_registry", "hop_name", "op_to_otlp", "otlp_to_hops",
-    "register_closeable", "shutdown", "stamp", "total_ms",
+    "CANONICAL_HOPS", "ContinuousProfiler", "FederatedView",
+    "FileSpanExporter", "FleetTimeline", "FlightRecorder",
+    "MetricsRegistry", "Objective", "REGISTRY", "SloEngine",
+    "TIMELINE_KINDS", "breakdown", "device_trace",
+    "format_breakdown", "get_registry", "hop_name", "op_to_otlp",
+    "otlp_to_hops", "register_closeable", "shutdown", "stamp",
+    "timeline_to_otlp", "total_ms",
 ]
 
 # ----------------------------------------------------------------------

@@ -230,9 +230,18 @@ class MetricsRegistry:
     """The family registry. Re-registering an existing name returns
     the SAME family (modules may be imported in any order and several
     instances share the aggregate series), but a kind or label-schema
-    mismatch fails loudly — two definitions of one name is a bug."""
+    mismatch fails loudly — two definitions of one name is a bug.
 
-    def __init__(self) -> None:
+    ``node`` is the registry's fleet identity: every snapshot a node
+    ships into ``obs.federation.FederatedView`` carries it
+    (``node_snapshot()``), and the federated merge keys gauges by it.
+    The process-wide default is ``"local"``; in-process multi-node
+    harnesses (chaos, test_replication) give each follower / partition
+    worker its own registry with its own node id so per-node series
+    never double-count into one registry."""
+
+    def __init__(self, node: str = "local") -> None:
+        self.node = node
         self._families: dict[str, _Family] = {}
 
     def _register(self, name: str, kind: str, help: str,
@@ -293,6 +302,12 @@ class MetricsRegistry:
             }
             for fam in families
         }
+
+    def node_snapshot(self) -> dict:
+        """``snapshot()`` wrapped with this registry's fleet identity
+        — the shape ``FederatedView.add_snapshot`` consumes from a
+        remote node's wire frame."""
+        return {"node": self.node, "metrics": self.snapshot()}
 
     def flat(self) -> dict[str, float]:
         """Flat scalar view for deltas: 'name{labels}' -> number

@@ -196,7 +196,8 @@ class SloEngine:
                  max_burn: float = 1.0,
                  clock: Callable[[], float] = time.monotonic,
                  registry: Optional[obs_metrics.MetricsRegistry] = None,
-                 max_samples: int = 4096):
+                 max_samples: int = 4096,
+                 refresh: Optional[Callable[[], object]] = None):
         if not (0 < fast_window_s <= slow_window_s):
             raise ValueError(
                 f"windows must be ordered: fast {fast_window_s} / "
@@ -207,6 +208,15 @@ class SloEngine:
         self.max_burn = max_burn
         self._clock = clock
         self._registry = registry or obs_metrics.REGISTRY
+        # the federation hook: a zero-arg callable run at the top of
+        # every tick(). Binding the engine to a FederatedView's output
+        # registry with refresh=view.refresh lets one objective grade
+        # the WHOLE plane — a per-partition goodput floor over merged
+        # counters — through the exact same burn-rate machinery
+        # (docs/OBSERVABILITY.md "Fleet observability"). Call
+        # view.refresh() once BEFORE construction so the families the
+        # objectives bind to exist.
+        self._refresh = refresh
         self._bound: dict[str, _BoundObjective] = {}
         # name -> ring of (t, bad, total); bounded — an engine left
         # ticking for days must not grow without bound
@@ -250,6 +260,8 @@ class SloEngine:
 
     def tick(self) -> None:
         """Record one sample per objective at the current clock."""
+        if self._refresh is not None:
+            self._refresh()  # federated registries re-merge first
         now = self._clock()
         self._last_tick = now
         for name, bound in self._bound.items():

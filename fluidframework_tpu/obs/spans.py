@@ -182,6 +182,79 @@ class FileSpanExporter:
             return [json.loads(line) for line in f if line.strip()]
 
 
+def timeline_trace_id(events) -> str:
+    """Deterministic 16-byte trace id for one fleet-timeline export:
+    derived from the causal seq range, so re-exporting the same
+    incident is byte-identical (the op-span contract, fleet-shaped)."""
+    first = events[0].seq if events else 0
+    last = events[-1].seq if events else 0
+    return _hex_id(f"timeline:{first}:{last}:{len(events)}", 16)
+
+
+def timeline_to_otlp(events, *, root_name: str = "fleet_timeline",
+                     trace_id: Optional[str] = None) -> dict:
+    """A fleet-timeline event sequence (obs/timeline.py
+    ``TimelineEvent`` ducks: seq/t/node/kind/fields) as an OTLP-JSON
+    trace document — the INCIDENT as a span tree next to the op
+    spans: one root covers the whole window, each event becomes a
+    child named ``kind`` whose window is [previous event, this event]
+    (the ``breakdown()`` delta attribution, fleet-shaped), with the
+    node, causal seq and scalar fields as attributes. Events are
+    already causally ordered by seq; ids are deterministic."""
+    ordered = sorted(events, key=lambda e: e.seq)
+    tid = trace_id or timeline_trace_id(ordered)
+    spans: list[dict] = []
+    if ordered:
+        root_id = _span_id(tid, 0)
+        spans.append({
+            "traceId": tid,
+            "spanId": root_id,
+            "name": root_name,
+            "kind": 1,
+            "startTimeUnixNano": _nanos(ordered[0].t),
+            "endTimeUnixNano": _nanos(ordered[-1].t),
+            "attributes": [_attr("fleet.events", len(ordered))],
+        })
+        prev_t = ordered[0].t
+        for i, e in enumerate(ordered):
+            attrs = [
+                _attr("fleet.node", e.node),
+                _attr("fleet.kind", e.kind),
+                _attr("fleet.seq", e.seq),
+                _attr("fluid.timestamp", repr(e.t)),
+            ]
+            for key in sorted(e.fields):
+                value = e.fields[key]
+                if isinstance(value, bool):
+                    value = str(value)
+                if isinstance(value, (int, float, str)):
+                    attrs.append(_attr(f"fleet.{key}", value))
+            spans.append({
+                "traceId": tid,
+                "spanId": _span_id(tid, i + 1),
+                "parentSpanId": root_id,
+                "name": e.kind,
+                "kind": 1,
+                "startTimeUnixNano": _nanos(prev_t),
+                "endTimeUnixNano": _nanos(e.t),
+                "attributes": attrs,
+            })
+            prev_t = e.t
+    return {
+        "resourceSpans": [{
+            "resource": {
+                "attributes": [
+                    _attr("service.name", RESOURCE_SERVICE_NAME),
+                ],
+            },
+            "scopeSpans": [{
+                "scope": {"name": SCOPE_NAME},
+                "spans": spans,
+            }],
+        }],
+    }
+
+
 def format_spans(traces: Iterable[Trace]) -> str:
     """Quick human view of the span tree (indent = parentage)."""
     rows = breakdown(traces)

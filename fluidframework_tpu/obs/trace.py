@@ -26,6 +26,23 @@ Hops are optional on the wire (a 1.0/1.1 peer that omits them still
 interoperates) and optional per path: the in-proc local driver has no
 ingress hop, the sidecar hops only appear for sidecar-tracked
 documents with ``trace_ops`` enabled.
+
+Fleet hops (PR13): ops that cross the replicated/partitioned plane
+additionally stamp
+
+    partition:route        the raw op was routed to its queue partition
+    repl:fence_check       the epoch fence admitted the write
+    repl:forward           the leader offered the op to its followers
+    repl:follower_append   one follower made the op durable (one stamp
+                           per follower that appended)
+    repl:quorum_ack        the quorum ack barrier was satisfied
+
+so the quorum wait on every acked op's critical path is its own hop
+(and OTLP child span) instead of silently inflating the
+sequencer-ticket hop. ``pool:migrate`` marks a mesh-pool hot-document
+migration at a settle boundary; it stamps the pool's own
+``migration_traces`` list (migrations are not per-op events) and
+feeds the fleet timeline (obs/timeline.py).
 """
 from __future__ import annotations
 
@@ -49,6 +66,13 @@ CANONICAL_HOPS = {
     ("broadcaster", "fanout"): "service fanned the sequenced op out",
     ("driver", "deliver"): "driver delivered the broadcast",
     ("client", "ack"): "submitting container matched its csn",
+    # fleet hops: the replicated / partitioned plane (PR13)
+    ("partition", "route"): "raw op routed to its queue partition",
+    ("repl", "fence_check"): "epoch fence admitted the write",
+    ("repl", "forward"): "leader offered the op to its followers",
+    ("repl", "follower_append"): "a follower made the op durable",
+    ("repl", "quorum_ack"): "quorum ack barrier satisfied",
+    ("pool", "migrate"): "mesh pool migrated a hot document at settle",
 }
 
 

@@ -58,6 +58,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..obs import metrics as obs_metrics
+from ..obs.trace import stamp as _trace_stamp
 from ..ops.bucket_ladder import BucketLadder
 from ..ops.host_bridge import coalesce_noops, pack_rows, replay_chunked
 from ..ops.merge_chunk import (
@@ -201,7 +202,8 @@ class MeshShardedPool:
     def __init__(self, mesh: Mesh, per_doc_capacity: int,
                  executor: Optional[str] = None,
                  doc_axis: str = DOC_AXIS,
-                 heat_decay: float = 0.5):
+                 heat_decay: float = 0.5,
+                 timeline=None):
         if doc_axis not in mesh.axis_names:
             raise ValueError(
                 f"mesh pool needs a {doc_axis!r} mesh axis "
@@ -254,6 +256,13 @@ class MeshShardedPool:
         self.dispatch_count = 0
         self.last_dispatch_depth = 0
         self.migration_count = 0
+        # fleet observability (PR13): migrations are settle-boundary
+        # EVENTS, not per-op hops, so each move stamps the canonical
+        # pool:migrate hop onto the pool's OWN trace list (bounded
+        # below) and lands on the attached FleetTimeline when one is
+        # wired (obs/timeline.py — chaos/config12 read it there)
+        self.timeline = timeline
+        self.migration_traces: list = []
 
     # -- bookkeeping ---------------------------------------------------
 
@@ -510,6 +519,11 @@ class MeshShardedPool:
         self._table = migrate_rows(self._table, perm)
         self.migration_count += 1
         _M_MIGRATIONS.inc()
+        _trace_stamp(self.migration_traces, "pool", "migrate")
+        del self.migration_traces[:-64]  # bounded, newest kept
+        if self.timeline is not None:
+            self.timeline.record("migration", node=f"shard-{src}",
+                                 slot=slot, src=src, dst=dst)
         self._set_member_gauges()
 
     # -- prewarm + reads ----------------------------------------------

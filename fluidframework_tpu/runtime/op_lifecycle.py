@@ -35,6 +35,13 @@ _HOP_MS = obs_metrics.REGISTRY.histogram(
 _SUBMIT_ACK_MS = obs_metrics.REGISTRY.histogram(
     "op_submit_ack_ms",
     "full submit→ack wall latency of ledgered ops")
+# the replicated plane's share of the critical path: repl:forward ->
+# repl:quorum_ack on every acked op that crossed the quorum barrier
+# (fed from the same ledger bridge, so the quorum wait is its own
+# series instead of silently inflating the sequencer-ticket hop)
+_QUORUM_WAIT_MS = obs_metrics.REGISTRY.histogram(
+    "repl_quorum_wait_ms",
+    "repl:forward→repl:quorum_ack wait of ledgered replicated ops")
 
 
 def _encode(envelope: dict) -> str:
@@ -204,6 +211,13 @@ class OpLatencyLedger:
             _HOP_MS.labels(hop=hop["hop"]).observe(hop["delta_ms"])
         if entry["hops"]:
             _SUBMIT_ACK_MS.observe(entry["total_ms"])
+        forward = next((t.timestamp for t in traces
+                        if t.service == "repl"
+                        and t.action == "forward"), None)
+        acked = [t.timestamp for t in traces
+                 if t.service == "repl" and t.action == "quorum_ack"]
+        if forward is not None and acked:
+            _QUORUM_WAIT_MS.observe((max(acked) - forward) * 1000.0)
         self._entries[csn] = entry
         while len(self._entries) > self.capacity:
             self._entries.pop(next(iter(self._entries)))

@@ -21,7 +21,12 @@ is the /metrics-equivalent dump command (Prometheus text exposition;
 
     python -m fluidframework_tpu.service --dump-slo HOST:PORT
 
-prints the live ``slo_report`` (per-objective verdicts + context).
+prints the live ``slo_report`` (per-objective verdicts + context);
+
+    python -m fluidframework_tpu.service --dump-fleet HOST:PORT
+
+prints the FEDERATED metrics view (obs/federation.py — leader +
+follower + partition-worker registries merged, node-labelled).
 """
 from __future__ import annotations
 
@@ -47,6 +52,33 @@ def dump_metrics(target: str, as_json: bool) -> int:
     if as_json:
         print(json.dumps(frame["metrics"], indent=2, sort_keys=True))
     else:
+        print(frame["text"], end="")
+    return 0
+
+
+def dump_fleet(target: str, as_json: bool) -> int:
+    """Connect to a running service and print its FEDERATED metrics
+    view (obs/federation.py: leader + follower + partition-worker
+    registries merged — sum counters, node-labelled gauges,
+    bucket-wise histograms)."""
+    import json
+    import socket
+
+    from .ingress import _parse_hostport, pack_frame, recv_frame_blocking
+
+    host, port = _parse_hostport(target)
+    with socket.create_connection((host, port), timeout=10.0) as sock:
+        sock.sendall(pack_frame({"type": "fleet-metrics", "rid": 1}))
+        frame = recv_frame_blocking(sock)
+    if frame.get("type") != "fleet-metrics":
+        print(f"unexpected response: {frame}")
+        return 1
+    if as_json:
+        print(json.dumps(
+            {"nodes": frame["nodes"], "metrics": frame["metrics"]},
+            indent=2, sort_keys=True))
+    else:
+        print(f"# fleet nodes: {', '.join(frame['nodes'])}")
         print(frame["text"], end="")
     return 0
 
@@ -117,14 +149,24 @@ def main() -> None:
                         help="print a RUNNING --slo service's "
                              "slo_report (per-objective burn-rate "
                              "verdicts, JSON) and exit")
+    parser.add_argument("--dump-fleet", default=None,
+                        metavar="HOST:PORT",
+                        help="print a RUNNING service's FEDERATED "
+                             "metrics view (leader + follower + "
+                             "partition-worker registries merged; "
+                             "Prometheus text, --json for the "
+                             "snapshot) and exit")
     parser.add_argument("--json", action="store_true",
-                        help="with --dump-metrics: emit the JSON "
-                             "snapshot instead of text exposition")
+                        help="with --dump-metrics/--dump-fleet: emit "
+                             "the JSON snapshot instead of text "
+                             "exposition")
     args = parser.parse_args()
     if args.dump_metrics is not None:
         raise SystemExit(dump_metrics(args.dump_metrics, args.json))
     if args.dump_slo is not None:
         raise SystemExit(dump_slo(args.dump_slo))
+    if args.dump_fleet is not None:
+        raise SystemExit(dump_fleet(args.dump_fleet, args.json))
     run_server(args.host, args.port, args.data_dir, args.partitions,
                args.broker, qos_enabled=args.qos,
                qos_ops_per_sec=args.qos_ops_per_sec,

@@ -75,6 +75,7 @@ MAX_FRAME = 64 * 1024 * 1024
 _KNOWN_FRAME_KINDS = frozenset((
     "connect_document", "submitOp", "read_ops", "fetch_summary",
     "upload_summary_chunk", "disconnect_document", "metrics", "slo",
+    "fleet-metrics",
 ))
 _FRAMES = obs_metrics.REGISTRY.counter(
     "ingress_frames_total", "frames dispatched by the ingress",
@@ -388,6 +389,7 @@ class AlfredServer:
                  tenants: Optional[Any] = None,
                  qos: Optional[Any] = None,
                  slo: Optional[Any] = None,
+                 fleet: Optional[Any] = None,
                  max_outbound_depth: Optional[int] = None,
                  outbound_drop_threshold: Optional[int] = None):
         self.local = local or LocalServer()
@@ -407,6 +409,12 @@ class AlfredServer:
         # engine is passive — it only reads registry families the
         # serving modules already bump). None = no objectives.
         self.slo = slo
+        # optional obs.FederatedView: answers the `fleet-metrics`
+        # frame with the MERGED leader/follower/partition-worker
+        # registries. None = a single-node view over the process
+        # registry, built lazily on first ask (the dev-service shape:
+        # one process IS the fleet).
+        self.fleet = fleet
         self.max_outbound_depth = (
             max_outbound_depth or self.MAX_OUTBOUND_DEPTH
         )
@@ -667,6 +675,26 @@ class AlfredServer:
                 "type": "metrics", "rid": frame.get("rid"),
                 "text": obs_metrics.REGISTRY.render_prometheus(),
                 "metrics": obs_metrics.REGISTRY.snapshot(),
+            })
+            return
+        if kind == "fleet-metrics":
+            # the fleet half of the `metrics` plane: the federated
+            # view re-merged as fresh as the ask (`--dump-fleet`
+            # reads this). Unauthenticated like `metrics` — merged
+            # names/labels never carry tenant content, and node ids
+            # are code-chosen.
+            if self.fleet is None:
+                from ..obs.federation import FederatedView
+
+                self.fleet = FederatedView()
+                self.fleet.add_registry(
+                    obs_metrics.REGISTRY.node, obs_metrics.REGISTRY)
+            merged = self.fleet.refresh()
+            session.send({
+                "type": "fleet-metrics", "rid": frame.get("rid"),
+                "nodes": self.fleet.nodes(),
+                "text": self.fleet.registry.render_prometheus(),
+                "metrics": merged,
             })
             return
         if kind == "slo":

@@ -35,6 +35,8 @@ import os
 import zlib
 from typing import Any, Callable, Iterator, Optional
 
+from ..obs import metrics as obs_metrics
+from ..obs.trace import stamp as _trace_stamp
 from ..protocol.messages import ClientDetail, DocumentMessage, Nack
 from ..qos.faults import (
     KIND_DROP,
@@ -288,10 +290,18 @@ class ReplicatedFileOrderingQueue(FileOrderingQueue):
                  follower_roots: list[str],
                  quorum: Optional[int] = None,
                  fence: Optional[Any] = None,
-                 epoch: Optional[int] = None):
-        from .replication import _G_FOLLOWERS
+                 epoch: Optional[int] = None,
+                 registry: Optional[Any] = None):
+        from .replication import _group_metrics
 
         super().__init__(root, n_partitions, fsync=True)
+        # injectable registry (the replication satellite fix): a
+        # partition worker under an in-process multi-node harness
+        # keeps its repl series on its OWN registry instead of
+        # double-counting into the process-wide one; default None =
+        # process-wide, unchanged for production
+        self._metrics = _group_metrics(
+            registry or obs_metrics.REGISTRY)
         if not follower_roots:
             raise ValueError(
                 "a replicated queue needs at least one follower root")
@@ -315,7 +325,7 @@ class ReplicatedFileOrderingQueue(FileOrderingQueue):
         else:
             self.epoch = fence.epoch if fence is not None else 0
         for p in range(n_partitions):
-            _G_FOLLOWERS.labels(partition=str(p)).set(
+            self._metrics["followers"].labels(partition=str(p)).set(
                 len(self.followers))
 
     @staticmethod
@@ -633,6 +643,13 @@ class PartitionedOrderingService:
                    op: DocumentMessage) -> None:
         from .ingress import document_message_to_json
 
+        # the cross-node hop: the raw op entered the partitioned
+        # transport. Stamped BEFORE serialization so the hop rides the
+        # queue record to the consuming partition worker (timestamp
+        # from the injected clock when one exists — recorded queue
+        # corpora stay byte-stable per seed)
+        _trace_stamp(op.traces, "partition", "route",
+                     timestamp=self.clock() if self.clock else None)
         payload = {"kind": "op", "client_id": client_id,
                    "op": document_message_to_json(op)}
         partition = self.partition_of(document_id)

@@ -66,6 +66,7 @@ import time
 from typing import Any, Optional
 
 from ..obs import metrics as obs_metrics
+from ..obs.trace import stamp as _stamp
 from ..protocol.messages import SequencedMessage
 from ..protocol.serialization import message_from_json, message_to_json
 from ..qos.faults import (
@@ -91,20 +92,51 @@ _SITE_LEASE = PLANE.site("repl.lease_expire", (KIND_DROP, KIND_ERROR))
 # exists to forbid
 _SITE_PROMOTE = PLANE.site("repl.promote", (KIND_ERROR,))
 
-_G_FOLLOWERS = obs_metrics.REGISTRY.gauge(
-    "repl_followers", "follower replicas behind the leader",
-    labelnames=("partition",))
-_G_LAG = obs_metrics.REGISTRY.gauge(
-    "repl_lag_ops",
-    "worst follower replication lag at the last append (ops)")
-_G_EPOCH = obs_metrics.REGISTRY.gauge(
-    "repl_epoch", "current sequencer leadership epoch")
-_C_FAILOVERS = obs_metrics.REGISTRY.counter(
-    "sequencer_failovers_total",
-    "follower promotions into the leader role")
-_C_FENCED = obs_metrics.REGISTRY.counter(
-    "sequencer_fenced_writes_total",
-    "writes refused by the epoch fence (deposed-leader attempts)")
+def _group_metrics(registry: obs_metrics.MetricsRegistry) -> dict:
+    """Register (or fetch) the replication families on ``registry``.
+
+    PR13 made every replication metric holder INJECTABLE: in-process
+    multi-node harnesses (chaos, test_replication) give the leader
+    and each follower their own registry so per-node series never
+    double-count into one process aggregate, and
+    ``obs.federation.FederatedView`` merges them back into the fleet
+    view. Default (registry=None at every ctor) stays the
+    process-wide REGISTRY — production topology is one node per
+    process, unchanged. Names stay literals HERE so fluidlint's
+    slo-unbound-objective collection sees them statically."""
+    return {
+        "followers": registry.gauge(
+            "repl_followers", "follower replicas behind the leader",
+            labelnames=("partition",)),
+        "lag": registry.gauge(
+            "repl_lag_ops",
+            "worst follower replication lag at the last append (ops)"),
+        "failovers": registry.counter(
+            "sequencer_failovers_total",
+            "follower promotions into the leader role"),
+        "anti_entropy": registry.counter(
+            "repl_anti_entropy_ops_total",
+            "ops applied via anti-entropy catch-up and promotion "
+            "suffix pulls"),
+    }
+
+
+def _fence_metrics(registry: obs_metrics.MetricsRegistry) -> dict:
+    return {
+        "epoch": registry.gauge(
+            "repl_epoch", "current sequencer leadership epoch"),
+        "fenced": registry.counter(
+            "sequencer_fenced_writes_total",
+            "writes refused by the epoch fence (deposed-leader "
+            "attempts)"),
+    }
+
+
+def _note(timeline, kind: str, node: str = "", **fields) -> None:
+    """Record a fleet-timeline event when a timeline is attached
+    (obs/timeline.py); replication runs timeline-less by default."""
+    if timeline is not None:
+        timeline.record(kind, node=node, **fields)
 
 
 class FencedWriteError(RuntimeError):
@@ -125,17 +157,26 @@ class EpochFence:
     write makes before anything can fan out. ``advance()`` is called
     only by lease acquisition — one epoch per leadership term."""
 
-    def __init__(self, epoch: int = 0):
+    def __init__(self, epoch: int = 0, registry=None, timeline=None):
         self.epoch = epoch
+        self.timeline = timeline
+        m = _fence_metrics(registry or obs_metrics.REGISTRY)
+        self._g_epoch = m["epoch"]
+        self._c_fenced = m["fenced"]
 
     def advance(self) -> int:
         self.epoch += 1
-        _G_EPOCH.set(self.epoch)
+        self._g_epoch.set(self.epoch)
+        _note(self.timeline, "epoch_advance", epoch=self.epoch)
         return self.epoch
 
     def check(self, epoch: int, **context) -> None:
         if epoch != self.epoch:
-            _C_FENCED.inc()
+            self._c_fenced.inc()
+            _note(self.timeline, "fenced_write", epoch=epoch,
+                  current=self.epoch,
+                  **{k: v for k, v in context.items()
+                     if isinstance(v, (int, float, str, bool))})
             raise FencedWriteError(
                 f"epoch fence: write under epoch {epoch} refused, "
                 f"current epoch is {self.epoch} ({context}) — the "
@@ -155,10 +196,11 @@ class SequencerLease:
     (``error`` — the split-brain trigger)."""
 
     def __init__(self, fence: EpochFence, ttl: float = 0.3,
-                 clock=None):
+                 clock=None, timeline=None):
         self.fence = fence
         self.ttl = ttl
         self.clock = clock or time.monotonic
+        self.timeline = timeline
         self.holder: Optional[str] = None
         self.expires_at = float("-inf")
 
@@ -176,6 +218,8 @@ class SequencerLease:
                 f"{self.expires_at - self.clock():.3f}s")
         self.holder = node_id
         self.expires_at = self.clock() + self.ttl
+        _note(self.timeline, "lease_grant", node=node_id,
+              ttl=self.ttl)
         return self.fence.advance()
 
     def renew(self, node_id: str, epoch: int) -> bool:
@@ -190,8 +234,11 @@ class SequencerLease:
             # fence refuses it (the split-brain candidate the
             # deposed-race chaos mode exercises)
             self.expires_at = self.clock()
+            _note(self.timeline, "lease_expire", node=node_id,
+                  origin="fault")
             return False
         self.expires_at = self.clock() + self.ttl
+        _note(self.timeline, "lease_renew", node=node_id)
         return True
 
     def force_expire(self, reason: str = "forced") -> None:
@@ -199,6 +246,8 @@ class SequencerLease:
         through the plane like any crash-time forced state."""
         _SITE_LEASE.force(KIND_ERROR, reason=reason)
         self.expires_at = self.clock()
+        _note(self.timeline, "lease_expire",
+              node=self.holder or "", origin="forced", reason=reason)
 
 
 class FollowerReplica:
@@ -210,9 +259,22 @@ class FollowerReplica:
     the ack barrier); a deferred (lagging) append is buffered
     in-memory and acked only once durable."""
 
-    def __init__(self, root: str, node_id: str):
+    def __init__(self, root: str, node_id: str, registry=None,
+                 timeline=None, stamp_ts=None):
         self.root = root
         self.node_id = node_id
+        # the follower's OWN registry (satellite fix: follower series
+        # used to alias the process-wide REGISTRY, double-counting
+        # leader + follower into one registry in in-process multi-node
+        # tests); default None keeps the process-wide aggregate —
+        # production runs one node per process
+        self._c_fenced = _fence_metrics(
+            registry or obs_metrics.REGISTRY)["fenced"]
+        self.timeline = timeline
+        # timestamp source for the repl:follower_append hop stamp:
+        # None = stamp()'s wall default; the group passes its injected
+        # clock through so recorded corpora stay byte-stable per seed
+        self._stamp_ts = stamp_ts
         os.makedirs(root, exist_ok=True)
         self.max_epoch_seen = 0
         self._heads: dict[str, int] = {}
@@ -263,7 +325,9 @@ class FollowerReplica:
 
     def _check_epoch(self, epoch: int, doc: str) -> None:
         if epoch < self.max_epoch_seen:
-            _C_FENCED.inc()
+            self._c_fenced.inc()
+            _note(self.timeline, "fenced_write", node=self.node_id,
+                  epoch=epoch, current=self.max_epoch_seen, doc=doc)
             raise FencedWriteError(
                 f"follower {self.node_id}: append under epoch "
                 f"{epoch} refused (seen {self.max_epoch_seen}, "
@@ -292,6 +356,11 @@ class FollowerReplica:
             f"follower {self.node_id} log must stay contiguous: "
             f"append seq {msg.sequence_number} onto head "
             f"{self.head(doc)} (doc {doc!r})")
+        # the cross-node hop: this follower holds the op durably (one
+        # stamp per follower that appends — catch-up/anti-entropy
+        # appends stamp too, honestly dating when the copy landed)
+        _stamp(msg.traces, "repl", "follower_append",
+               timestamp=self._stamp_ts() if self._stamp_ts else None)
         fh = self._fh(doc)
         fh.write(json.dumps(message_to_json(msg)) + "\n")
         fh.flush()
@@ -383,6 +452,8 @@ class ReplicatedOpLog(FileOpLog):
             # quorum never accepted
             self._ops.pop()
             raise
+        _stamp(msg.traces, "repl", "fence_check",
+               timestamp=self._group._trace_ts())
         super()._persist_append(msg)  # local fsync (the PR9 barrier)
         self._group.replicate_before_fanout(
             self._doc, self._epoch, msg, self)
@@ -471,20 +542,41 @@ class ReplicatedSequencerGroup:
     def __init__(self, root: str, n_followers: int = 2,
                  quorum: Optional[int] = None, clock=None,
                  lease_ttl: float = 0.3, scope: str = "docs",
-                 server_kwargs: Optional[dict] = None):
+                 server_kwargs: Optional[dict] = None,
+                 registry=None, follower_registries=None,
+                 timeline=None):
         if n_followers < 1:
             raise ValueError(
                 "a replicated sequencer needs at least one follower "
                 "(n_followers >= 1), or host loss loses acked ops")
+        if follower_registries is not None and \
+                len(follower_registries) != n_followers:
+            raise ValueError(
+                f"{len(follower_registries)} follower registries for "
+                f"{n_followers} followers")
         self.root = root
         self.scope = scope
+        # timestamps for the repl hop stamps follow the clock ONLY
+        # when one was injected: the default group clock is
+        # time.monotonic (lease arithmetic), and monotonic stamps
+        # must never mix into wall-clock hop tables
+        self._injected_clock = clock is not None
         self.clock = clock or time.monotonic
-        self.fence = EpochFence()
+        self.registry = registry or obs_metrics.REGISTRY
+        self.timeline = timeline
+        self.metrics = _group_metrics(self.registry)
+        self.fence = EpochFence(registry=self.registry,
+                                timeline=timeline)
         self.lease = SequencerLease(self.fence, ttl=lease_ttl,
-                                    clock=self.clock)
+                                    clock=self.clock,
+                                    timeline=timeline)
         self.followers = [
-            FollowerReplica(os.path.join(root, f"node-{i}"),
-                            f"node-{i}")
+            FollowerReplica(
+                os.path.join(root, f"node-{i}"), f"node-{i}",
+                registry=(follower_registries[i - 1]
+                          if follower_registries else None),
+                timeline=timeline, stamp_ts=self._trace_ts,
+            )
             for i in range(1, n_followers + 1)
         ]
         # quorum over ALL nodes (leader included); default = a strict
@@ -505,12 +597,18 @@ class ReplicatedSequencerGroup:
         self.epoch = self.lease.acquire(self.leader_id)
         self.server = self._build_server(
             os.path.join(root, "node-0"))
-        _G_FOLLOWERS.labels(partition=self.scope).set(
+        self.metrics["followers"].labels(partition=self.scope).set(
             len(self.followers))
 
     def _build_server(self, durable_dir: str) -> ReplicatedLocalServer:
         return ReplicatedLocalServer(self, durable_dir,
                                      **self.server_kwargs)
+
+    def _trace_ts(self) -> Optional[float]:
+        """Timestamp for repl hop stamps: the injected clock when one
+        exists (byte-stable recorded corpora per seed), else None —
+        stamp()'s wall default."""
+        return self.clock() if self._injected_clock else None
 
     # -- committed watermark -------------------------------------------
 
@@ -539,6 +637,14 @@ class ReplicatedSequencerGroup:
         deterministic order (the leader genuinely WAITS on its
         quorum, exactly what an ack barrier means)."""
         seq = msg.sequence_number
+        # the hop pair around the quorum barrier: forward marks the
+        # leader offering the op to its followers, quorum_ack marks
+        # the barrier satisfied — so the quorum wait is its OWN hop
+        # in op_breakdown()/OTLP instead of silently inflating the
+        # sequencer-ticket hop (the ledger bridge feeds
+        # repl_quorum_wait_ms from exactly this pair)
+        _stamp(msg.traces, "repl", "forward",
+               timestamp=self._trace_ts())
         acked = 1  # the leader's own fsynced append
         for f in self.followers:
             if self._offer(f, doc, epoch, msg, source_log):
@@ -557,9 +663,11 @@ class ReplicatedSequencerGroup:
                        reverse=True)
         self._committed[doc] = max(self.committed(doc),
                                    heads[self.quorum - 1])
+        _stamp(msg.traces, "repl", "quorum_ack",
+               timestamp=self._trace_ts())
         lag = max((seq - f.head(doc) for f in self.followers),
                   default=0)
-        _G_LAG.set(lag)
+        self.metrics["lag"].set(lag)
         self.max_lag_observed = max(self.max_lag_observed, lag)
 
     def _offer(self, f: FollowerReplica, doc: str, epoch: int,
@@ -588,7 +696,10 @@ class ReplicatedSequencerGroup:
                   source_log) -> None:
         f.flush_lag(doc)
         if f.head(doc) < upto:
-            f.sync_from(doc, source_log.read(f.head(doc), upto))
+            applied = f.sync_from(
+                doc, source_log.read(f.head(doc), upto))
+            if applied:
+                self.metrics["anti_entropy"].inc(applied)
 
     def _force_sync(self, f: FollowerReplica, doc: str, epoch: int,
                     msg: SequencedMessage, source_log) -> None:
@@ -625,6 +736,10 @@ class ReplicatedSequencerGroup:
             raise LeaseHeldError(
                 f"lease held by {self.lease.holder!r}; failover "
                 "requires the lease to lapse first")
+        # the election OBSERVES the lapse — the failover timeline's
+        # detection-phase boundary (obs/timeline.py failover_phases)
+        _note(self.timeline, "lease_expire",
+              node=self.lease.holder or "", origin="observed")
         if not self.followers:
             raise RuntimeError("no followers left to promote")
         if candidate is None:
@@ -652,8 +767,14 @@ class ReplicatedSequencerGroup:
                 continue
             for doc in peer.documents():
                 if peer.head(doc) > candidate.head(doc):
-                    candidate.sync_from(
+                    applied = candidate.sync_from(
                         doc, peer.read_log(doc, candidate.head(doc)))
+                    if applied:
+                        self.metrics["anti_entropy"].inc(applied)
+                        _note(self.timeline, "anti_entropy",
+                              node=candidate.node_id,
+                              source=peer.node_id, doc=doc,
+                              ops=applied)
         candidate.flush_lag()
         candidate.drop_lag()
         # 3) mint the new epoch and fence everyone else out
@@ -671,7 +792,10 @@ class ReplicatedSequencerGroup:
         # head, so ticketing resumes at exactly the replicated head
         candidate.close()
         self.server = self._build_server(candidate.root)
-        _C_FAILOVERS.inc()
-        _G_FOLLOWERS.labels(partition=self.scope).set(
+        self.metrics["failovers"].inc()
+        self.metrics["followers"].labels(partition=self.scope).set(
             len(self.followers))
+        _note(self.timeline, "promotion", node=self.leader_id,
+              epoch=self.epoch,
+              followers_left=len(self.followers))
         return self.server

@@ -56,6 +56,8 @@ from typing import Optional
 
 from ..loader.container import Container
 from ..obs import metrics as obs_metrics
+from ..obs.federation import FederatedView
+from ..obs.timeline import FleetTimeline
 from ..protocol.constants import batch_flag
 from ..protocol.messages import (
     ClientDetail,
@@ -441,6 +443,27 @@ class ChaosHarness:
         self.group = None  # ReplicatedSequencerGroup when replicated
         self.crashes = 0
         self.failovers = 0
+        # fleet observability (replicated runs): per-NODE registries
+        # (the satellite fix — leader and follower series must not
+        # double-count into one process registry), federated back
+        # into one view, plus the causal failover timeline, all on
+        # the step clock so every derived field is seed-deterministic
+        self.timeline: Optional[FleetTimeline] = None
+        self.fleet: Optional[FederatedView] = None
+        self.node_registries: dict[str, obs_metrics.MetricsRegistry] \
+            = {}
+        if replicated:
+            self.node_registries = {
+                f"node-{i}": obs_metrics.MetricsRegistry(
+                    node=f"node-{i}")
+                for i in range(n_followers + 1)
+            }
+            self.timeline = FleetTimeline(
+                clock=self.clock,
+                registry=self.node_registries["node-0"])
+            self.fleet = FederatedView(clock=self.clock)
+            for node, reg in self.node_registries.items():
+                self.fleet.add_registry(node, reg)
         self._boot()
 
     def _boot(self) -> None:
@@ -459,6 +482,12 @@ class ChaosHarness:
                 self.group = ReplicatedSequencerGroup(
                     self.durable_dir, n_followers=self.n_followers,
                     clock=self.clock, lease_ttl=0.3,
+                    registry=self.node_registries["node-0"],
+                    follower_registries=[
+                        self.node_registries[f"node-{i}"]
+                        for i in range(1, self.n_followers + 1)
+                    ],
+                    timeline=self.timeline,
                     server_kwargs=dict(
                         checkpoint_every=self.checkpoint_every,
                         storage_breaker=breaker,
@@ -617,6 +646,10 @@ class ChaosHarness:
         assert self.group is not None, "kill_leader needs replicated="
         self._abandon_all()
         self.server = None
+        # the incident's t0 on the causal timeline (failover_phases
+        # measures detection from here)
+        self.timeline.record("leader_kill", node=self.group.leader_id,
+                             mode=mode)
         self.group.kill_leader()
         # the host is gone; nobody renews: walk the step clock past
         # the TTL — the lease seam is what converts host loss into an
@@ -744,6 +777,12 @@ class ChaosReport:
     kill_mode: Optional[str] = None
     fenced_writes: int = 0
     repl_lag_max: int = 0
+    # fleet observability (replicated runs): the causal timeline's
+    # event sequence and the federated per-node counter totals —
+    # both step-clock/seed deterministic, both in
+    # deterministic_fields so same-seed runs must match bit-for-bit
+    timeline_events: list = field(default_factory=list)
+    fleet_counters: dict = field(default_factory=dict)
     # the broker coverage leg (exactly-once through the partitioned
     # queue seams, every run)
     broker_ops: int = 0
@@ -768,6 +807,8 @@ class ChaosReport:
             "kill_mode": self.kill_mode,
             "fenced_writes": self.fenced_writes,
             "repl_lag_max": self.repl_lag_max,
+            "timeline_events": list(self.timeline_events),
+            "fleet_counters": dict(self.fleet_counters),
             "broker_ops": self.broker_ops,
         }
 
@@ -872,7 +913,10 @@ def run_chaos(seed: int, faults: bool = True,
         k: int(v) for k, v in sorted(delta.items())
         if k.startswith("chaos_injected_total")
     }
-    report.fenced_writes = int(delta.get(
+    # replicated runs already read this from the federated per-node
+    # registries inside _run_chaos_into; the process-wide delta is
+    # the non-replicated path's (zero) share
+    report.fenced_writes += int(delta.get(
         "sequencer_fenced_writes_total", 0))
     report.converged = not report.failures
     return report
@@ -1167,6 +1211,17 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
     report.failovers = harness.failovers
     if harness.group is not None:
         report.repl_lag_max = harness.group.max_lag_observed
+        # the fleet-obs differential surface: timeline sequence +
+        # federated counter totals, bit-identical per seed (both
+        # ride the step clock and the per-node registries)
+        report.timeline_events = \
+            harness.timeline.deterministic_events()
+        report.fleet_counters = harness.fleet.counter_totals()
+        # fence counters live on the per-NODE registries now (the
+        # double-count fix), so the report reads them from the
+        # federated totals instead of the process-wide delta
+        report.fenced_writes = int(report.fleet_counters.get(
+            "sequencer_fenced_writes_total", 0))
     report.acked_ops = acked_box[0]
     # PLANE.fired is reset by arm(): an unarmed (oracle) run must
     # report [] — not whatever sequence a PREVIOUS armed run left
@@ -1319,6 +1374,12 @@ class ChaosStormReport:
     failover_time_s: Optional[float] = None
     failovers: int = 0
     repl_lag_max: int = 0
+    # the causal decomposition of failover_time_s (detection /
+    # anti-entropy / promotion / first-ack — obs/timeline.py) and the
+    # federated fleet snapshot: both step-clock deterministic, both
+    # asserted bit-equal across config12's x2 storm runs
+    failover_phases: Optional[dict] = None
+    fleet_metrics: dict = field(default_factory=dict)
 
     def deterministic_fields(self) -> dict:
         return {
@@ -1332,6 +1393,8 @@ class ChaosStormReport:
             "failover_time_s": self.failover_time_s,
             "failovers": self.failovers,
             "repl_lag_max": self.repl_lag_max,
+            "failover_phases": dict(self.failover_phases or {}),
+            "fleet_metrics": dict(self.fleet_metrics),
         }
 
 
@@ -1353,7 +1416,13 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
     kills the leader at that step (mid-storm is the interesting
     window): ``failover_time_s`` = step clock from the kill to the
     first post-failover ack, reported next to ``goodput_dip`` —
-    bench config12's headline number."""
+    bench config12's headline number. PR13 measures it off the fleet
+    timeline (leader_kill -> first_ack on the step clock, so the
+    lease-TTL detection window is INCLUDED — the pre-PR13 number
+    started counting only after the kill step ended) and decomposes
+    it into ``failover_phases`` (detection / anti-entropy /
+    promotion / first-ack, summing to failover_time_s exactly);
+    ``fleet_metrics`` carries the federated per-node snapshot."""
     import re
     import tempfile
 
@@ -1448,8 +1517,15 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
             if (kill_leader_step is not None
                     and step >= kill_leader_step
                     and report.failover_time_s is None and acked):
-                report.failover_time_s = round(
-                    (step - kill_leader_step) * 0.05, 6)
+                harness.timeline.record(
+                    "first_ack", node=harness.group.leader_id,
+                    step=step)
+                phases = harness.timeline.failover_phases()
+                assert phases is not None, (
+                    "first ack landed but the timeline has no "
+                    "complete kill->promotion chain")
+                report.failover_phases = phases
+                report.failover_time_s = round(phases["total_s"], 6)
             report.offered_ops += offered
             report.acked_ops += acked
             rolling.append((offered, acked))
@@ -1501,6 +1577,7 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
         report.failovers = harness.failovers
         if harness.group is not None:
             report.repl_lag_max = harness.group.max_lag_observed
+            report.fleet_metrics = harness.fleet.refresh()
             if kill_leader_step is not None \
                     and report.failover_time_s is None:
                 report.failures.append(

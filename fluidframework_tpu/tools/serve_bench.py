@@ -52,6 +52,7 @@ from typing import Optional
 
 from ..obs import metrics as obs_metrics
 from ..obs.profiler import ContinuousProfiler
+from ..obs.federation import FederatedView
 from ..obs.slo import Objective, SloEngine
 from ..qos import (
     AdmissionController,
@@ -170,6 +171,9 @@ class ServeBenchReport:
     slo_breached_objectives: list = field(default_factory=list)
     # profiler (None when profile=False)
     profiler: Optional[dict] = None
+    # fleet surface: the node ids the ingress's FederatedView merges
+    # (one node here; the replicated plane grows the list)
+    fleet_nodes: list = field(default_factory=list)
     wall_s: float = 0.0
     metrics_delta: dict = field(default_factory=dict)
 
@@ -314,6 +318,15 @@ def run_serve_bench(config: Optional[ServeBenchConfig] = None
             pressure=pressure, clock=clock,
         )
     server = AlfredServer(qos=qos)
+    # the fleet surface (obs/federation.py): serve_bench is a
+    # one-node plane, but the ingress it benchmarks serves the same
+    # `fleet-metrics` frame a replicated deployment does — wired
+    # here so config9 exercises the federated path, on the manual
+    # clock so fleet_snapshot_age_s stays deterministic
+    fleet = FederatedView(clock=clock)
+    fleet.add_registry(obs_metrics.REGISTRY.node,
+                       obs_metrics.REGISTRY)
+    server.fleet = fleet
 
     # --- session population (writers + read-mode subscribers) -------
     writers = [
@@ -485,6 +498,7 @@ def run_serve_bench(config: Optional[ServeBenchConfig] = None
     report.slo_breached_objectives = sorted(breached)
     if profiler is not None:
         report.profiler = profiler.summary()
+    report.fleet_nodes = fleet.nodes()
     report.wall_s = time.perf_counter() - wall0
     report.metrics_delta = obs_metrics.REGISTRY.delta(before)
     return report

@@ -479,6 +479,11 @@ def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
             "recovery_time_s": report.recovery_time_s,
             "kill_leader_step": report.kill_leader_step,
             "failover_time_s": report.failover_time_s,
+            # the causal decomposition + federated fleet snapshot
+            # (obs/timeline.py, obs/federation.py): a kill-leader run
+            # reports WHERE the failover time went, not one number
+            "failover_phases": report.failover_phases,
+            "fleet_metrics": report.fleet_metrics,
             "failovers": report.failovers,
             "repl_lag_max": report.repl_lag_max,
             "converged": report.converged,

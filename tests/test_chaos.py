@@ -153,6 +153,52 @@ def test_failover_convergence_differential(seed, failover_oracle):
     assert report.alpha_text == failover_oracle.alpha_text, detail
     assert report.alpha_kv == failover_oracle.alpha_kv, detail
     assert report.beta_text == failover_oracle.beta_text, detail
+    # --- fleet-obs determinism (PR13): the same seed re-run must
+    # reproduce the causal timeline and the federated per-node
+    # counter totals bit-for-bit
+    again = run_chaos_failover(seed)
+    assert again.timeline_events == report.timeline_events, detail
+    assert again.fleet_counters == report.fleet_counters, detail
+    assert again.deterministic_fields() == \
+        report.deterministic_fields(), detail
+    _check_timeline_causality(report, detail)
+
+
+def _check_timeline_causality(report, detail: str) -> None:
+    """Timeline causal order must never contradict the chaos plane:
+    seq strictly increases with non-decreasing step-clock time, every
+    schedule-injected lease lapse (the error faults PLANE.fired
+    records at repl.lease_expire, forced ones included) has exactly
+    one fault/forced lease_expire event, every promotion is preceded
+    by a lease_expire, and the federated counters agree with the
+    report's own counts."""
+    events = report.timeline_events  # (seq, t, node, kind, fields)
+    assert events, detail
+    seqs = [e[0] for e in events]
+    times = [e[1] for e in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs), \
+        detail
+    assert all(a <= b for a, b in zip(times, times[1:])), detail
+    fired_lapses = [f for f in report.fired
+                    if f[0] == "repl.lease_expire" and f[2] == "error"]
+    tl_lapses = [e for e in events
+                 if e[3] == "lease_expire"
+                 and dict(e[4]).get("origin") in ("fault", "forced")]
+    assert len(tl_lapses) == len(fired_lapses), (
+        f"{detail}: {len(tl_lapses)} fault/forced lease_expire "
+        f"events vs {len(fired_lapses)} plane firings")
+    promos = [e for e in events if e[3] == "promotion"]
+    assert len(promos) == report.failovers, detail
+    for promo in promos:
+        assert any(e[3] == "lease_expire" and e[0] < promo[0]
+                   for e in events), (
+            f"{detail}: promotion seq {promo[0]} with no prior "
+            "lease_expire — an election cannot causally precede the "
+            "lapse that triggered it")
+    fenced = [e for e in events if e[3] == "fenced_write"]
+    assert len(fenced) == report.fenced_writes, detail
+    assert report.fleet_counters.get(
+        "sequencer_failovers_total", 0) == report.failovers, detail
 
 
 def test_seed_range_covers_every_kill_mode():

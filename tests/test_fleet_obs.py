@@ -1,0 +1,426 @@
+"""Fleet observability plane (PR13): metrics federation
+(obs/federation.py), the causal fleet timeline (obs/timeline.py), the
+timeline OTLP export, and the `fleet-metrics` wire/CLI surface.
+
+The cross-node trace-propagation half (repl hops in op_breakdown,
+round-trip bit-exactness through the replicated plane) lives in
+tests/test_replication.py next to the mechanisms it instruments; the
+chaos-federation determinism differential lives in tests/test_chaos.py.
+"""
+import json
+
+import pytest
+
+from fluidframework_tpu.obs import metrics as obs_metrics
+from fluidframework_tpu.obs.federation import FederatedView, parse_labels
+from fluidframework_tpu.obs.metrics import MetricsRegistry
+from fluidframework_tpu.obs.slo import Objective, SloEngine
+from fluidframework_tpu.obs.spans import timeline_to_otlp
+from fluidframework_tpu.obs.timeline import TIMELINE_KINDS, FleetTimeline
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _two_nodes():
+    a = MetricsRegistry(node="n0")
+    b = MetricsRegistry(node="n1")
+    return a, b
+
+
+# ======================================================================
+# federation: merge semantics
+
+
+def test_counters_sum_across_nodes_per_label_set():
+    a, b = _two_nodes()
+    a.counter("f_ops_total", "ops").inc(3)
+    b.counter("f_ops_total", "ops").inc(4)
+    a.counter("f_lab_total", "ops", labelnames=("k",)) \
+        .labels(k="x").inc(1)
+    b.counter("f_lab_total", "ops", labelnames=("k",)) \
+        .labels(k="x").inc(2)
+    b.counter("f_lab_total", "ops", labelnames=("k",)) \
+        .labels(k="y").inc(7)
+    view = FederatedView(clock=_Clock())
+    view.add_registry("n0", a)
+    view.add_registry("n1", b)
+    merged = view.refresh()
+    assert merged["f_ops_total"]["values"][""] == 7.0
+    assert merged["f_lab_total"]["values"]['{k="x"}'] == 3.0
+    assert merged["f_lab_total"]["values"]['{k="y"}'] == 7.0
+    # and the merged registry serves every existing surface
+    assert "f_ops_total 7.0" in view.registry.render_prometheus()
+    assert view.registry.flat()["f_ops_total"] == 7.0
+
+
+def test_gauges_keep_per_node_identity_under_a_node_label():
+    a, b = _two_nodes()
+    a.gauge("f_head", "head").set(5)
+    b.gauge("f_head", "head").set(9)
+    a.gauge("f_depth", "d", labelnames=("shard",)) \
+        .labels(shard="0").set(2)
+    view = FederatedView(clock=_Clock())
+    view.add_registry("n0", a)
+    view.add_registry("n1", b)
+    merged = view.refresh()
+    assert merged["f_head"]["values"] == {
+        '{node="n0"}': 5.0, '{node="n1"}': 9.0}
+    assert merged["f_depth"]["values"] == {
+        '{node="n0",shard="0"}': 2.0}
+
+
+def test_histograms_merge_bucket_wise():
+    a, b = _two_nodes()
+    ha = a.histogram("f_lat_ms", "lat", buckets=(1.0, 10.0))
+    hb = b.histogram("f_lat_ms", "lat", buckets=(1.0, 10.0))
+    ha.observe(0.5)
+    ha.observe(5.0)
+    hb.observe(50.0)
+    view = FederatedView(clock=_Clock())
+    view.add_registry("n0", a)
+    view.add_registry("n1", b)
+    merged = view.refresh()
+    value = merged["f_lat_ms"]["values"][""]
+    assert value["count"] == 3
+    assert value["sum"] == 55.5
+    assert value["buckets"] == {"1.0": 1, "10.0": 2, "+Inf": 3}
+    # a bound SLO objective over the merged histogram sees the fleet
+    child = view.registry.get("f_lat_ms")._solo()
+    assert child.count_le(10.0) == 2
+
+
+def test_label_escaping_round_trips_through_the_merge():
+    a, b = _two_nodes()
+    hairy = 'q"uo\\te\nnl'
+    a.counter("f_esc_total", "ops", labelnames=("k",)) \
+        .labels(k=hairy).inc(1)
+    b.counter("f_esc_total", "ops", labelnames=("k",)) \
+        .labels(k=hairy).inc(2)
+    view = FederatedView(clock=_Clock())
+    view.add_registry("n0", a)
+    view.add_registry("n1", b)
+    view.refresh()
+    child = view.registry.get("f_esc_total").labels(k=hairy)
+    assert child.value == 3.0
+    # the parser really is _render_labels' inverse
+    rendered = list(a.snapshot()["f_esc_total"]["values"])[0]
+    assert parse_labels(rendered) == [("k", hairy)]
+
+
+def test_kind_mismatch_and_bucket_mismatch_fail_loudly():
+    a, b = _two_nodes()
+    a.counter("f_clash", "x")
+    b.gauge("f_clash", "x")
+    view = FederatedView(clock=_Clock())
+    view.add_registry("n0", a)
+    view.add_registry("n1", b)
+    with pytest.raises(ValueError, match="two definitions"):
+        view.refresh()
+    c, d = _two_nodes()
+    c.histogram("f_h_ms", "x", buckets=(1.0,)).observe(0.5)
+    d.histogram("f_h_ms", "x", buckets=(2.0,)).observe(0.5)
+    view2 = FederatedView(clock=_Clock())
+    view2.add_registry("n0", c)
+    view2.add_registry("n1", d)
+    with pytest.raises(ValueError, match="bucket bounds"):
+        view2.refresh()
+
+
+def test_view_refuses_to_federate_its_own_registry():
+    view = FederatedView(clock=_Clock())
+    with pytest.raises(ValueError):
+        view.add_registry("fleet", view.registry)
+
+
+def test_wire_snapshots_age_and_node_identity():
+    a, _ = _two_nodes()
+    a.counter("f_remote_total", "ops").inc(2)
+    clock = _Clock(t=100.0)
+    view = FederatedView(clock=clock)
+    shipped = a.node_snapshot()
+    assert shipped["node"] == "n0"
+    view.add_snapshot(shipped["node"], shipped["metrics"],
+                      captured_at=90.0)
+    merged = view.refresh()
+    assert merged["f_remote_total"]["values"][""] == 2.0
+    assert merged["fleet_nodes"]["values"][""] == 1.0
+    assert merged["fleet_snapshot_age_s"]["values"][""] == 10.0
+    # a live registry under the same node id replaces the snapshot
+    view.add_registry("n0", a)
+    merged = view.refresh()
+    assert merged["fleet_snapshot_age_s"]["values"][""] == 0.0
+
+
+def test_refresh_rewrites_children_in_place():
+    """Child identity survives refresh — the SLO binding contract."""
+    a, _ = _two_nodes()
+    counter = a.counter("f_grow_total", "ops")
+    counter.inc(1)
+    view = FederatedView(clock=_Clock())
+    view.add_registry("n0", a)
+    view.refresh()
+    child = view.registry.get("f_grow_total")._solo()
+    assert child.value == 1.0
+    counter.inc(4)
+    view.refresh()
+    assert view.registry.get("f_grow_total")._solo() is child
+    assert child.value == 5.0
+
+
+def test_refresh_prunes_series_a_replaced_node_stopped_exporting():
+    """Ghost-metric regression: replacing a node's source (the
+    documented add_snapshot/add_registry replacement semantics) must
+    not leave the old node state being served forever."""
+    a, _ = _two_nodes()
+    a.counter("f_old_total", "ops").inc(7)
+    a.gauge("f_old_head", "head").set(3)
+    view = FederatedView(clock=_Clock())
+    view.add_registry("n1", a)
+    merged = view.refresh()
+    assert merged["f_old_total"]["values"][""] == 7.0
+    # the replacement snapshot no longer carries f_old_*
+    fresh = MetricsRegistry(node="n1")
+    fresh.counter("f_new_total", "ops").inc(1)
+    view.add_snapshot("n1", fresh.snapshot())
+    merged = view.refresh()
+    assert "f_old_total" not in merged
+    assert "f_old_head" not in merged
+    assert "f_old_total" not in view.counter_totals()
+    assert merged["f_new_total"]["values"][""] == 1.0
+    # per-series pruning too: a vanished label set goes, the rest stay
+    b = MetricsRegistry(node="n2")
+    fam = b.counter("f_lab2_total", "ops", labelnames=("k",))
+    fam.labels(k="x").inc(1)
+    fam.labels(k="y").inc(2)
+    view.add_registry("n2", b)
+    view.refresh()
+    b2 = MetricsRegistry(node="n2")
+    b2.counter("f_lab2_total", "ops", labelnames=("k",)) \
+        .labels(k="y").inc(5)
+    view.add_snapshot("n2", b2.snapshot())
+    merged = view.refresh()
+    assert merged["f_lab2_total"]["values"] == {'{k="y"}': 5.0}
+    # the view's own gauges survive pruning
+    assert merged["fleet_nodes"]["values"][""] == 2.0
+
+
+# ======================================================================
+# federated SLO grading
+
+
+def test_slo_objective_grades_the_whole_plane_through_federation():
+    """A per-partition goodput objective bound to MERGED counters:
+    one healthy partition cannot mask a failing one's share of the
+    fleet's error budget (the federated good/total ratio is the
+    plane's, not any node's)."""
+    a, b = _two_nodes()
+    ga = a.counter("f_good_total", "good")
+    ta = a.counter("f_off_total", "offered")
+    gb = b.counter("f_good_total", "good")
+    tb = b.counter("f_off_total", "offered")
+    clock = _Clock()
+    view = FederatedView(clock=clock)
+    view.add_registry("n0", a)
+    view.add_registry("n1", b)
+    view.refresh()  # families must exist before binding
+    engine = SloEngine(
+        [Objective("fleet-goodput", kind="goodput",
+                   good_metric="f_good_total",
+                   total_metric="f_off_total", target=0.9)],
+        registry=view.registry, refresh=view.refresh,
+        fast_window_s=1.0, slow_window_s=12.0, clock=clock,
+    )
+    # node n0 serves perfectly; n1 drops half its ops
+    for _ in range(20):
+        ga.inc()
+        ta.inc()
+        gb.inc(0.5)
+        tb.inc()
+        clock.t += 0.1
+        engine.tick()
+    report = engine.evaluate()
+    (obj,) = report["objectives"]
+    assert obj["verdict"] == "breach", obj
+    assert obj["fast"]["burn"] > 1.0
+
+
+# ======================================================================
+# the fleet timeline
+
+
+def test_timeline_kinds_are_validated_and_counted():
+    reg = MetricsRegistry(node="t")
+    tl = FleetTimeline(clock=_Clock(), registry=reg)
+    tl.record("lease_grant", node="node-0", ttl=0.3)
+    tl.record("promotion", node="node-1", epoch=2)
+    with pytest.raises(ValueError, match="unknown timeline event"):
+        tl.record("warp_drive", node="node-0")
+    flat = reg.flat()
+    assert flat['timeline_events_total{kind="lease_grant"}'] == 1
+    assert flat['timeline_events_total{kind="promotion"}'] == 1
+    assert len(tl) == 2
+    assert [e.kind for e in tl.events("promotion")] == ["promotion"]
+
+
+def test_timeline_seq_is_causal_and_capacity_bounded():
+    clock = _Clock()
+    tl = FleetTimeline(clock=clock, registry=MetricsRegistry(),
+                       capacity=8)
+    for i in range(20):
+        clock.t = i * 0.05
+        tl.record("lease_renew", node="node-0")
+    assert len(tl) == 8
+    assert tl.dropped == 12
+    seqs = [e.seq for e in tl.events()]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert seqs[-1] == 20  # causal position survives the ring
+
+
+def test_failover_phases_decompose_and_sum_exactly():
+    clock = _Clock()
+    tl = FleetTimeline(clock=clock, registry=MetricsRegistry())
+    assert tl.failover_phases() is None
+    tl.record("leader_kill", node="node-0", mode="clean")
+    clock.t = 0.31
+    tl.record("lease_expire", node="node-0", origin="observed")
+    clock.t = 0.32
+    tl.record("anti_entropy", node="node-1", source="node-2", ops=3)
+    clock.t = 0.34
+    tl.record("lease_grant", node="node-1", ttl=0.3)
+    tl.record("epoch_advance", epoch=2)
+    clock.t = 0.35
+    tl.record("promotion", node="node-1", epoch=2)
+    assert tl.failover_phases() is None  # no first_ack yet
+    clock.t = 0.50
+    tl.record("first_ack", node="node-1")
+    phases = tl.failover_phases()
+    assert phases == {
+        "detection_s": 0.31,
+        "anti_entropy_s": pytest.approx(0.03),
+        "promotion_s": pytest.approx(0.01),
+        "first_ack_s": pytest.approx(0.15),
+        "total_s": 0.5,
+    }
+    total = (phases["detection_s"] + phases["anti_entropy_s"]
+             + phases["promotion_s"] + phases["first_ack_s"])
+    assert total == pytest.approx(phases["total_s"])
+    assert "leader_kill" in tl.format()
+
+
+def test_timeline_otlp_export_is_deterministic_and_causal():
+    clock = _Clock()
+    tl = FleetTimeline(clock=clock, registry=MetricsRegistry())
+    tl.record("leader_kill", node="node-0", mode="clean")
+    clock.t = 0.31
+    tl.record("lease_expire", node="node-0", origin="observed")
+    clock.t = 0.35
+    tl.record("promotion", node="node-1", epoch=2)
+    doc = timeline_to_otlp(tl.events())
+    assert doc == timeline_to_otlp(tl.events()), "export not stable"
+    (rs,) = doc["resourceSpans"]
+    spans = rs["scopeSpans"][0]["spans"]
+    assert [s["name"] for s in spans] == [
+        "fleet_timeline", "leader_kill", "lease_expire", "promotion"]
+    root, children = spans[0], spans[1:]
+    assert all(s["parentSpanId"] == root["spanId"] for s in children)
+    assert all(s["traceId"] == root["traceId"] for s in children)
+    # child windows tile the incident ([prev, t] — the hop-span shape)
+    assert children[1]["startTimeUnixNano"] == \
+        children[0]["endTimeUnixNano"]
+    attrs = {a["key"]: a["value"] for a in children[2]["attributes"]}
+    assert attrs["fleet.node"]["stringValue"] == "node-1"
+    assert attrs["fleet.epoch"]["intValue"] == "2"
+    # the exact-float contract carries over from the op spans
+    assert attrs["fluid.timestamp"]["stringValue"] == repr(0.35)
+
+
+def test_timeline_kind_table_is_a_pure_literal():
+    """The CANONICAL_HOPS discipline: the metric label vocabulary is
+    bounded by a literal table."""
+    import ast
+
+    with open("fluidframework_tpu/obs/timeline.py") as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "TIMELINE_KINDS"
+            for t in node.targets
+        ):
+            assert ast.literal_eval(node.value) == TIMELINE_KINDS
+            break
+    else:
+        raise AssertionError("TIMELINE_KINDS literal not found")
+
+
+# ======================================================================
+# the wire + CLI surface
+
+
+def test_ingress_fleet_metrics_frame_and_dump_cli(alfred):
+    import socket as socket_mod
+
+    from fluidframework_tpu.service.__main__ import dump_fleet
+    from fluidframework_tpu.service.ingress import (
+        pack_frame,
+        recv_frame_blocking,
+    )
+
+    server = alfred()
+    with socket_mod.create_connection(
+            ("127.0.0.1", server.port), timeout=10) as sock:
+        sock.sendall(pack_frame({"type": "fleet-metrics", "rid": 9}))
+        frame = recv_frame_blocking(sock)
+    assert frame["type"] == "fleet-metrics" and frame["rid"] == 9
+    # no view attached -> the process registry as a one-node fleet
+    assert frame["nodes"] == [obs_metrics.REGISTRY.node]
+    assert "fleet_nodes 1.0" in frame["text"]
+    assert "sequencer_tickets_total" in frame["metrics"]
+    assert frame["metrics"]["fleet_nodes"]["values"][""] == 1.0
+    # the CLI command against the same server, both expositions
+    assert dump_fleet(f"127.0.0.1:{server.port}", False) == 0
+    assert dump_fleet(f"127.0.0.1:{server.port}", True) == 0
+
+
+def test_ingress_serves_an_attached_multi_node_view():
+    from fluidframework_tpu.service.ingress import (
+        AlfredServer,
+        _ClientSession,
+    )
+
+    a, b = _two_nodes()
+    a.counter("f_wire_total", "ops").inc(1)
+    b.counter("f_wire_total", "ops").inc(2)
+    view = FederatedView(clock=_Clock())
+    view.add_registry("n0", a)
+    view.add_registry("n1", b)
+    server = AlfredServer(fleet=view)
+    s = _ClientSession(server, None)
+    server._sessions.add(s)
+    server._dispatch(s, {"type": "fleet-metrics", "rid": 1})
+    raw = s.outbound.get_nowait()
+    frame = json.loads(raw[4:])
+    assert frame["type"] == "fleet-metrics"
+    assert frame["nodes"] == ["n0", "n1"]
+    assert frame["metrics"]["f_wire_total"]["values"][""] == 3.0
+
+
+# ======================================================================
+# serve_bench rides the fleet surface
+
+
+def test_serve_bench_report_carries_the_fleet_nodes():
+    from fluidframework_tpu.tools.serve_bench import (
+        ServeBenchConfig,
+        run_serve_bench,
+    )
+
+    report = run_serve_bench(ServeBenchConfig(
+        duration_s=0.5, n_docs=1, readers_per_doc=0,
+        sidecar_docs=0, qos=False))
+    assert report.fleet_nodes == [obs_metrics.REGISTRY.node]

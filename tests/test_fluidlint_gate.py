@@ -111,6 +111,48 @@ def test_obs_untimed_hop_rule_fires_on_unregistered_hops(tmp_path):
     ) == []
 
 
+def test_canonical_hops_resolve_to_live_stamp_sites():
+    """Registry non-vacuity (the WALL_CLOCK_SINKS / FANOUT_GATES
+    contract, applied to the hop table): every CANONICAL_HOPS entry —
+    including the PR13 replication/partition/pool hops — must be
+    reachable from a real literal ``stamp()``/``Trace()`` call site
+    in the package tree. A ghost hop entry fails HERE, so the table
+    can only describe hops something actually emits."""
+    from fluidframework_tpu.analysis.obscheck import (
+        collect_stamped_hops,
+        load_canonical_hops,
+        stale_canonical_hops,
+    )
+
+    files = core.walk_python_files(["fluidframework_tpu"])
+    stale = stale_canonical_hops(files)
+    assert stale == [], (
+        "CANONICAL_HOPS entries with no live stamp()/Trace() call "
+        f"site (ghost vocabulary — delete or stamp them): {stale}"
+    )
+    # the new fleet hops specifically come from the surfaces the
+    # tentpole instrumented: the replicated sequencer, the
+    # partitioned transport, and the mesh pool's settle boundary
+    by_file = {}
+    for relpath in ("service/replication.py",
+                    "service/partitioning.py",
+                    "parallel/mesh_pool.py"):
+        (src,) = [f for f in files if f.relpath.endswith(relpath)]
+        by_file[relpath] = collect_stamped_hops([src])
+    assert {("repl", "fence_check"), ("repl", "forward"),
+            ("repl", "follower_append"), ("repl", "quorum_ack")} <= \
+        by_file["service/replication.py"]
+    assert ("partition", "route") in \
+        by_file["service/partitioning.py"]
+    assert ("pool", "migrate") in by_file["parallel/mesh_pool.py"]
+
+    # the staleness detector itself is not vacuous: an injected
+    # ghost entry is caught
+    ghost = load_canonical_hops() | {("ghost", "hop")}
+    assert stale_canonical_hops(files, hops=ghost) == \
+        [("ghost", "hop")]
+
+
 def test_obs_canonical_table_stays_statically_readable():
     """obscheck must keep extracting the hop table without importing
     the obs package (the linter depends on nothing it lints); this

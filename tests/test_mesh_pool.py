@@ -223,6 +223,13 @@ def test_hotspot_migration_is_bit_exact_vs_single_shard_pool():
     never-migrated single-shard pool and the container oracle."""
     server = LocalServer()
     mesh_sc, seq_sc, docs, containers, strings = _hotspot_pair(server)
+    # fleet observability (PR13): attach a timeline so each migration
+    # lands as a causal event next to its pool:migrate hop stamp
+    from fluidframework_tpu.obs.metrics import MetricsRegistry
+    from fluidframework_tpu.obs.timeline import FleetTimeline
+
+    timeline = FleetTimeline(registry=MetricsRegistry(node="pool"))
+    mesh_sc._pool.timeline = timeline
     # all three docs overflow into the pool in one settle: placement
     # [doc-0, doc-2] / [doc-1] on the 2-shard mesh
     for doc in docs:
@@ -247,6 +254,15 @@ def test_hotspot_migration_is_bit_exact_vs_single_shard_pool():
             sc.sync()
     assert mesh_sc._pool.migration_count > 0, (
         "the hot-spot run must actually migrate")
+    # every migration stamped the canonical pool:migrate hop and
+    # recorded a timeline event carrying the move's src/dst shards
+    pool = mesh_sc._pool
+    assert len(pool.migration_traces) == pool.migration_count
+    assert all(t.service == "pool" and t.action == "migrate"
+               for t in pool.migration_traces)
+    moves = timeline.events("migration")
+    assert len(moves) == pool.migration_count
+    assert all(e.fields["src"] != e.fields["dst"] for e in moves)
     assert seq_sc._pool.dispatch_count > 0
     assert mesh_sc.host_mode_docs() == 0
     assert seq_sc.host_mode_docs() == 0

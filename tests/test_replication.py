@@ -435,6 +435,163 @@ def test_failover_refused_while_lease_live(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# fleet observability (PR13): cross-node trace propagation + the
+# injectable-registry separation fix
+
+
+def test_replicated_op_shows_repl_hops_in_breakdown_and_otlp(tmp_path):
+    """The acceptance criterion: an op acked through the replicated
+    plane shows the repl hops — fence_check, forward, one
+    follower_append per appending follower, quorum_ack — as its own
+    breakdown rows between the sequencer/scriptorium hops and the
+    fanout, and the OTLP export round-trips bit-exact."""
+    g = ReplicatedSequencerGroup(str(tmp_path))  # wall clock: real
+    c = _load_writer(g)
+    _drive(c, 3)
+    entry = c.op_trace()
+    names = [h["hop"] for h in entry["hops"]]
+    for hop in ("repl:fence_check", "repl:forward",
+                "repl:follower_append", "repl:quorum_ack"):
+        assert hop in names, names
+    assert names.count("repl:follower_append") == 2, (
+        "both followers appended on the clean path")
+    order = [names.index(h) for h in (
+        "sequencer:ticket", "scriptorium:write", "repl:fence_check",
+        "repl:forward", "repl:quorum_ack", "broadcaster:fanout",
+        "client:ack")]
+    assert order == sorted(order), names
+    # quorum wait is its own hop AND its own histogram (the ledger
+    # bridge feeds repl_quorum_wait_ms from the forward->quorum_ack
+    # pair), no longer silently inflating the sequencer-ticket hop
+    flat = obs_metrics.REGISTRY.flat()
+    assert flat["repl_quorum_wait_ms_count"] >= 3
+    # OTLP: repl hops become child spans; the round trip stays exact
+    from fluidframework_tpu.obs.spans import op_to_otlp, otlp_to_hops
+
+    doc = op_to_otlp(entry["traces"], document_id="doc",
+                     client_id="w", csn=entry["clientSequenceNumber"])
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    span_names = [s["name"] for s in spans]
+    assert "repl:forward" in span_names
+    assert "repl:quorum_ack" in span_names
+    assert otlp_to_hops(doc) == sorted(
+        entry["traces"], key=lambda t: t.timestamp)
+    assert doc == op_to_otlp(
+        otlp_to_hops(doc), document_id="doc", client_id="w",
+        csn=entry["clientSequenceNumber"]), "re-export not byte-equal"
+    c.close()
+
+
+def test_anti_entropy_counter_moves_on_catch_up(tmp_path):
+    from fluidframework_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry(node="lead")
+    clock = _Clock()
+    g = ReplicatedSequencerGroup(str(tmp_path), clock=clock,
+                                 registry=reg)
+    c = _load_writer(g)
+    _drive(c, 2)
+    assert reg.flat()["repl_anti_entropy_ops_total"] == 0
+    # drop one follower's acks twice (first + retry): the next clean
+    # append catches it up from the leader's log — anti-entropy
+    PLANE.site("repl.append_ack").push(KIND_DROP, 2)
+    _text_channel(c).insert_text(0, "D.")
+    c.flush()
+    _text_channel(c).insert_text(0, "E.")
+    c.flush()
+    assert reg.flat()["repl_anti_entropy_ops_total"] >= 1
+    c.close()
+
+
+def test_follower_registries_do_not_double_count_into_process(
+        tmp_path):
+    """The satellite fix, pinned: leader and follower fence series
+    land on their OWN injected registries; the process-wide registry
+    sees none of it (in-process multi-node tests used to double-count
+    every node into one aggregate). Default construction (no
+    registry) keeps the process-wide behaviour — production is one
+    node per process."""
+    from fluidframework_tpu.obs.metrics import MetricsRegistry
+
+    lead = MetricsRegistry(node="node-0")
+    f1 = MetricsRegistry(node="node-1")
+    f2 = MetricsRegistry(node="node-2")
+    clock = _Clock()
+    before = obs_metrics.REGISTRY.flat().get(
+        "sequencer_fenced_writes_total", 0)
+    g = ReplicatedSequencerGroup(
+        str(tmp_path), clock=clock, registry=lead,
+        follower_registries=[f1, f2])
+    c = _load_writer(g)
+    _drive(c, 2)
+    c.close()
+    # a follower-side fencing-token refusal counts on the FOLLOWER's
+    # registry only
+    follower = g.followers[0]
+    follower.note_epoch(99)
+    with pytest.raises(FencedWriteError):
+        follower.append_durable("doc", 1, _msg(
+            follower.head("doc") + 1))
+    assert f1.flat()["sequencer_fenced_writes_total"] == 1
+    assert f2.flat()["sequencer_fenced_writes_total"] == 0
+    # a deposed-leader refusal counts on the GROUP's registry only
+    g.lease.force_expire(reason="test")
+    g.failover()
+    with pytest.raises(FencedWriteError):
+        g.fence.check(1)
+    assert lead.flat()["sequencer_fenced_writes_total"] == 1
+    # and the process-wide registry never moved
+    assert obs_metrics.REGISTRY.flat().get(
+        "sequencer_fenced_writes_total", 0) == before
+    # federation puts the fleet total back together
+    from fluidframework_tpu.obs.federation import FederatedView
+
+    view = FederatedView(clock=clock)
+    for node, reg in (("node-0", lead), ("node-1", f1),
+                      ("node-2", f2)):
+        view.add_registry(node, reg)
+    totals = view.counter_totals()
+    assert totals["sequencer_fenced_writes_total"] == 2
+    assert totals["sequencer_failovers_total"] == 1
+    # gauges stay per-node under the node label
+    merged = view.refresh()
+    assert '{node="node-0"}' in merged["repl_epoch"]["values"]
+
+
+def test_group_timeline_records_the_failover_chain(tmp_path):
+    from fluidframework_tpu.obs.metrics import MetricsRegistry
+    from fluidframework_tpu.obs.timeline import FleetTimeline
+
+    clock = _Clock()
+    tl = FleetTimeline(clock=clock, registry=MetricsRegistry())
+    g = ReplicatedSequencerGroup(str(tmp_path), clock=clock,
+                                 timeline=tl)
+    c = _load_writer(g)
+    _drive(c, 3)
+    c.close()
+    kinds = [e.kind for e in tl.events()]
+    assert kinds[0] == "lease_grant" and kinds[1] == "epoch_advance"
+    assert "lease_renew" in kinds  # the replication heartbeat
+    g.kill_leader()
+    tl.record("leader_kill", node="node-0", mode="clean")
+    clock.t += 1.0
+    g.failover()
+    clock.t += 0.05
+    tl.record("first_ack", node=g.leader_id)
+    phases = tl.failover_phases()
+    assert phases is not None
+    assert phases["detection_s"] == pytest.approx(1.0)
+    assert phases["first_ack_s"] == pytest.approx(0.05)
+    assert phases["total_s"] == pytest.approx(1.05)
+    # the causal chain is ordered: expire -> epoch -> promotion
+    tail = [e.kind for e in tl.events()
+            if e.kind in ("lease_expire", "epoch_advance",
+                          "promotion", "leader_kill")]
+    assert tail[-4:] == ["leader_kill", "lease_expire",
+                         "epoch_advance", "promotion"]
+
+
+# ----------------------------------------------------------------------
 # O(1) sequencer fast-forward (promotion used to pay O(log))
 
 
