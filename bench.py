@@ -42,7 +42,7 @@ import time
 
 STAGES = ("probe", "fuzz", "config1", "config2", "config3", "config4",
           "config5", "config6", "config7", "config8", "config9",
-          "config10", "config11", "config12")
+          "config10", "config11", "config12", "config13")
 
 # Machine-readable corpus identity, stamped into EVERY stage record
 # (r5 silently changed the stream mix — flow-mix quarter joined — and
@@ -66,6 +66,7 @@ STAGE_CORPUS = {
     "config10": {"generator": "mesh-hotspot", "version": 1},
     "config11": {"generator": "chaos-standard", "version": 1},
     "config12": {"generator": "chaos-failover", "version": 1},
+    "config13": {"generator": "chaos-netsplit", "version": 1},
 }
 
 
@@ -2240,6 +2241,131 @@ def stage_config12(scale: str, reps: int, cooldown: float) -> dict:
     }
 
 
+def stage_config13(scale: str, reps: int, cooldown: float) -> dict:
+    """Partition tolerance under chaos (docs/ROBUSTNESS.md "Partition
+    tolerance & degraded mode"): the config11 storm over the
+    replicated plane with the LEADER PARTITIONED away from its quorum
+    mid-storm (lease on its side: no election, pure quorum loss) —
+    reporting ``unavailability_s`` (the degraded window on the step
+    clock: writes nacked retriable-unavailable, reads clamped at the
+    committed watermark) and ``degraded_read_s`` (until the first
+    post-heal ack) next to ``goodput_dip``/``recovery_time_s``
+    (config12's ``failover_time_s`` sibling numbers), x2 runs
+    bit-equal. A convergence leg runs one seed per enumerated split
+    mode (minority-leader election+fencing+rejoin, symmetric with
+    grace-detach+rejoin, lease isolation, flap with a mid-split
+    crash, wipe+rejoin) against the fault-free oracle and FAILS the
+    round on any divergence — each seed also plants a mid-file
+    bit-rot state the scrubber must read-repair."""
+    from fluidframework_tpu.testing.chaos import (
+        netsplit_plan,
+        run_chaos,
+        run_chaos_netsplit,
+        run_chaos_storm,
+    )
+
+    steps, storm = {
+        "full": (240, (80, 160)),
+        "cpu": (120, (40, 80)),
+        "smoke": (60, (20, 40)),
+    }[scale]
+    quarter = (storm[1] - storm[0]) // 4
+    window = (storm[0] + quarter, storm[1] - quarter)
+
+    # --- storm leg: unavailability window next to goodput dip --------
+    t0 = time.perf_counter()
+    storm_rep = run_chaos_storm(seed=13, steps=steps, storm=storm,
+                                netsplit=window)
+    storm_wall = time.perf_counter() - t0
+    assert storm_rep.converged, (
+        f"config13 storm diverged: {storm_rep.failures}")
+    assert storm_rep.unavailability_s is not None and \
+        storm_rep.unavailability_s > 0, (
+            "config13's netsplit never entered degraded mode")
+    assert storm_rep.degraded_read_s is not None and \
+        storm_rep.degraded_read_s >= storm_rep.unavailability_s - 1e-9
+    assert storm_rep.unavailable_nacks > 0
+    assert storm_rep.failovers == 0, (
+        "config13 is the no-election mode: the lease stays with the "
+        "leader — a failover means the scenario drifted")
+    again = run_chaos_storm(seed=13, steps=steps, storm=storm,
+                            netsplit=window)
+    assert again.deterministic_fields() == \
+        storm_rep.deterministic_fields(), (
+            "config13 determinism violation: "
+            f"{again.deterministic_fields()} != "
+            f"{storm_rep.deterministic_fields()}")
+
+    # --- convergence leg: one seed per enumerated split mode ---------
+    oracle = run_chaos(0, faults=False)
+    assert oracle.converged, oracle.failures
+    # seeds 0/1/2/3/7: minority_leader, symmetric, lease_isolated,
+    # flap(+crash), wipe_rejoin(+crash) — netsplit_plan is a pure
+    # function of the seed, asserted below, not assumed
+    diff = []
+    seeds = (0, 1, 2, 3, 7)
+    for seed in seeds:
+        rep = run_chaos_netsplit(seed)
+        assert rep.converged and \
+            rep.alpha_text == oracle.alpha_text and \
+            rep.beta_text == oracle.beta_text, (
+                f"config13 netsplit differential FAILED for seed "
+                f"{seed} (reproduce: run_chaos_netsplit({seed})): "
+                f"{rep.failures}")
+        assert rep.scrub_repairs >= 1, (
+            f"seed {seed}: the planted bit-rot state was never "
+            "scrub-repaired — the leg went vacuous")
+        diff.append({
+            "seed": seed,
+            "mode": rep.netsplit_mode,
+            "partitions": rep.partitions,
+            "unavailable_nacks": rep.unavailable_nacks,
+            "degraded_s": rep.degraded_s,
+            "rejoins": rep.rejoins,
+            "scrub_repairs": rep.scrub_repairs,
+            "fenced_writes": rep.fenced_writes,
+            "fired": len(rep.fired),
+        })
+    got_modes = {d["mode"] for d in diff}
+    from fluidframework_tpu.testing.chaos import SPLIT_MODES
+
+    assert got_modes == set(SPLIT_MODES), (
+        f"config13 split-mode coverage: {got_modes} != "
+        f"{set(SPLIT_MODES)} (netsplit_plan: "
+        f"{[netsplit_plan(s, 40)['mode'] for s in seeds]})")
+    minority = [d for d in diff if d["mode"] == "minority_leader"]
+    assert minority and minority[0]["fenced_writes"] > 0 and \
+        minority[0]["rejoins"] >= 1, (
+            "the minority-leader seed must record fenced writes AND "
+            "a post-heal rejoin — the deposed leader staying fenced "
+            "IS the test")
+
+    return {
+        "steps": steps,
+        "storm_window": list(storm),
+        "netsplit_window": list(window),
+        "unavailability_s": storm_rep.unavailability_s,
+        "degraded_read_s": storm_rep.degraded_read_s,
+        "unavailable_nacks": storm_rep.unavailable_nacks,
+        "offered_ops": storm_rep.offered_ops,
+        "acked_ops": storm_rep.acked_ops,
+        "goodput_steady": round(storm_rep.goodput_steady, 4),
+        "goodput_dip": round(storm_rep.goodput_dip, 4),
+        "recovery_steps": storm_rep.recovery_steps,
+        "recovery_time_s": storm_rep.recovery_time_s,
+        "faults_fired": storm_rep.fired,
+        "chaos_counts": storm_rep.chaos_counts,
+        "netsplit_runs": diff,
+        "kernel_ops_per_sec": round(
+            storm_rep.acked_ops / max(storm_wall, 1e-9), 1),
+        "wall_s": round(storm_wall, 3),
+        "deterministic": "step clock, seeded schedule, x2 netsplit "
+                         "storms bit-equal; netsplit differential "
+                         "asserts oracle equality for every "
+                         "enumerated split mode + scrub repair",
+    }
+
+
 STAGE_FNS = {
     "probe": stage_probe,
     "fuzz": stage_fuzz,
@@ -2255,6 +2381,7 @@ STAGE_FNS = {
     "config10": stage_config10,
     "config11": stage_config11,
     "config12": stage_config12,
+    "config13": stage_config13,
 }
 
 

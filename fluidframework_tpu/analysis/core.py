@@ -214,7 +214,7 @@ FAMILY_RULES = {
     "lockcheck": ("lock-unlocked-write", "lock-external-write"),
     "obscheck": ("obs-untimed-hop", "slo-unbound-objective"),
     "qoscheck": ("service-unbounded-queue", "retry-without-jitter",
-                 "fence-before-fanout"),
+                 "fence-before-fanout", "unbounded-blocking-wait"),
     "concheck": ("lock-order-cycle", "async-blocking-call",
                  "await-holding-lock"),
     "shapecheck": ("donated-buffer-reuse", "unladdered-jit-shape",

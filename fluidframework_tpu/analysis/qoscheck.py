@@ -49,6 +49,20 @@ follower-side stale-epoch refusal, and this rule pins the ordering
 statically so a refactor cannot silently move the fan-out above the
 fence. Scope: ``service`` path components (where the replicated
 sequencer lives).
+
+``unbounded-blocking-wait``: a polling/blocking wait loop in the
+service plane — a ``while`` loop whose body sleeps (``time.sleep``,
+an injectable ``self._sleep``/``wait`` primitive) while it waits for
+external progress — must carry a DEADLINE: a comparison against a
+clock reading or a ``deadline``/``timeout``-named bound somewhere in
+the loop. The replicated sequencer's quorum barrier was this bug
+(the ``while acked < quorum`` wait): a minority-side leader hung
+every submitter forever instead of answering with the retriable
+unavailable nack (docs/ROBUSTNESS.md "Partition tolerance &
+degraded mode"). Scope: ``service`` path components. A wait that is
+legitimately unbounded (none known today — the allowlist stays
+empty) would carry a justified inline
+``# fluidlint: disable=unbounded-blocking-wait``.
 """
 from __future__ import annotations
 
@@ -359,6 +373,112 @@ def _check_fence_before_fanout(src: SourceFile, module: str,
             ))
 
 
+#: callee-name fragments that mark a call as a blocking/polling wait
+#: primitive (the loop body "waits" through them): time.sleep and the
+#: injectable sleep/wait seams the service plane uses
+_WAIT_NAME_FRAGMENTS = ("sleep", "wait")
+
+#: name fragments that mark a Name/Attribute as a deadline bound
+_DEADLINE_FRAGMENTS = ("deadline", "timeout", "expires")
+
+#: callee-name fragments whose call result reads a clock
+_CLOCK_FRAGMENTS = ("clock", "monotonic", "time")
+
+
+def _is_wait_call(node: ast.Call) -> bool:
+    name = _callee_name(node.func)
+    if name is None:
+        return False
+    ident = name.strip("_").lower()
+    return ("sleep" in ident or ident == "wait"
+            or ident.startswith("wait_") or ident.endswith("_wait"))
+
+
+def _names_deadline(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        ident = node.id.lower()
+    elif isinstance(node, ast.Attribute):
+        ident = node.attr.lower()
+    else:
+        return False
+    return any(f in ident for f in _DEADLINE_FRAGMENTS)
+
+
+def _reads_clock(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = _callee_name(node.func)
+    if name is None:
+        return False
+    ident = name.lower()
+    return any(f in ident for f in _CLOCK_FRAGMENTS)
+
+
+def _has_deadline_bound(loop: ast.While) -> bool:
+    """A comparison anywhere in the loop (test or body) where either
+    side names a deadline/timeout or reads a clock — the shape
+    ``if self.clock() >= deadline: ...`` the fixed barrier carries."""
+    for node in ast.walk(loop):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left, *node.comparators]
+        if any(_names_deadline(s) or _reads_clock(s) for s in sides):
+            return True
+    return False
+
+
+def _check_blocking_wait(src: SourceFile, module: str,
+                         findings: list) -> None:
+    quals: dict[ast.AST, str] = {}
+    for cls in ast.walk(src.tree):
+        if isinstance(cls, ast.ClassDef):
+            for item in cls.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    quals[item] = f"{cls.name}.{item.name}"
+    parents: dict = {}
+    for parent in ast.walk(src.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+
+    def enclosing_scope(node) -> str:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                return quals.get(cur, cur.name)
+            cur = parents.get(cur)
+        return "<module>"
+
+    hits: dict[str, int] = {}
+    for loop in ast.walk(src.tree):
+        if not isinstance(loop, ast.While):
+            continue
+        waits = any(isinstance(n, ast.Call) and _is_wait_call(n)
+                    for stmt in loop.body for n in ast.walk(stmt))
+        if not waits:
+            continue
+        if _has_deadline_bound(loop):
+            continue
+        qual = enclosing_scope(loop)
+        n = hits.get(qual, 0) + 1
+        hits[qual] = n
+        suffix = "" if n == 1 else str(n)
+        findings.append(Finding(
+            rule="unbounded-blocking-wait",
+            path=src.relpath, line=loop.lineno,
+            message=(
+                "blocking wait loop with no deadline in the service "
+                "plane: a vanished peer set (netsplit, dead "
+                "followers) hangs every caller forever — bound the "
+                "wait on an injectable clock (`if clock() >= "
+                "deadline: refuse`) and answer with a retriable "
+                "unavailable nack (docs/ROBUSTNESS.md)"
+            ),
+            key=f"{module}:{qual}.blockwait{suffix}",
+        ))
+
+
 def check(files: list[SourceFile]) -> list[Finding]:
     findings: list[Finding] = []
     for src in files:
@@ -373,6 +493,7 @@ def check(files: list[SourceFile]) -> list[Finding]:
         aliases = _import_aliases(src.tree)
         module = src.relpath.rsplit("/", 1)[-1]
         _check_fence_before_fanout(src, module, findings)
+        _check_blocking_wait(src, module, findings)
         parents: dict = {}
         for parent in ast.walk(src.tree):
             for child in ast.iter_child_nodes(parent):

@@ -579,7 +579,15 @@ class Container(EventEmitter):
             else:
                 self._reconnect_on_nack = False
                 if not self.connected:
-                    self.connect()  # replays pending ops, fresh csn
+                    try:
+                        self.connect()  # replays pending, fresh csn
+                    except Exception:
+                        # the service refused the reconnect (e.g. the
+                        # quorum-loss degraded window refusing joins):
+                        # re-arm, or every later flush would silently
+                        # stop retrying and strand the pending ops
+                        self._reconnect_on_nack = True
+                        raise
         self.runtime.flush()
 
     # ------------------------------------------------------------------

@@ -60,6 +60,19 @@ TIMELINE_KINDS = {
     "promotion": "a follower was promoted into the leader role",
     "migration": "the mesh pool moved a hot document between shards",
     "first_ack": "first client ack through the new leader",
+    # partition tolerance (service/replication.py netsplit plane)
+    "partition": "the network split into reachability islands",
+    "heal": "a partition's links came back",
+    "degraded_enter": "quorum/lease unprovable: writes refuse with "
+                      "retriable unavailable nacks (read-only "
+                      "brownout at the committed watermark)",
+    "degraded_exit": "quorum/lease provable again: acks resumed",
+    "membership": "the quorum membership shrank (grace TTL) or grew "
+                  "back (rejoin)",
+    "rejoin": "a crashed/wiped follower rejoined via full "
+              "anti-entropy resync behind the epoch fence",
+    "scrub_repair": "the scrubber read-repaired a bit-rotted record "
+                    "from a quorum peer",
 }
 
 

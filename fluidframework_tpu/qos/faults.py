@@ -58,6 +58,8 @@ KIND_ERROR_BURST = "error_burst"  # N consecutive errors (breaker trip)
 KIND_DEFER = "defer"            # skip this opportunity, retry later
 KIND_TORN_WRITE = "torn_write"  # prefix-truncated bytes (crash state)
 KIND_CORRUPT = "corrupt"        # insane length prefix on the wire
+KIND_PARTITION = "partition"    # links between islands go dark
+KIND_HEAL = "heal"              # a partition's links come back
 
 #: how many consecutive events an ``error_burst`` poisons once fired —
 #: sized past every breaker failure_threshold in the tree (3) so one

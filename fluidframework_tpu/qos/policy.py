@@ -32,6 +32,15 @@ SHED_ORDER = (CLASS_SUMMARY, CLASS_CATCHUP, CLASS_WRITE)
 # shed reasons (bounded metric label values)
 REASON_RATE_LIMIT = "rate_limit"
 REASON_PRESSURE = "pressure"
+# quorum-loss degraded mode (service/replication.py): not a pressure
+# tier — the service refuses the write because it cannot PROVE it
+# durable (quorum unreachable) or cannot prove its own leadership
+# (lease service unreachable past the TTL). Rides throttle nacks in
+# the same OPTIONAL shed_class wire field as the pressure reasons
+# (1.0/1.1 peers that ignore it interop — test_wire_compat), and the
+# nack is retriable by construction: the op stays with its submitter
+# and the PR9 reconnect/resubmit path replays it after the heal.
+REASON_UNAVAILABLE = "unavailable"
 
 DEFAULT_SHED_AT = {
     CLASS_SUMMARY: TIER_ELEVATED,

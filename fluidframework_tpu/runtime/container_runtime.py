@@ -321,6 +321,18 @@ class ContainerRuntime(EventEmitter):
 
     def _replay_pending(self) -> None:
         self.reconnect_epoch += 1
+        # fold unflushed outbox ops into the pending queue FIRST (they
+        # are strictly newer than every flushed-pending entry, so
+        # append order is submit order): a reconnect that interrupted
+        # a flush — the service refusing the reconnect's join during
+        # a quorum-loss degraded window — leaves raw envelopes here,
+        # and flushing them AFTER this replay would double-submit ops
+        # the channels are about to regenerate (found by the netsplit
+        # differential as a merge-tree pending-queue-out-of-order
+        # assert on the post-heal resubmit)
+        for op in self._outbox:
+            self.pending.on_submit(op)
+        self._outbox.clear()
         for op in self.pending.drain():
             if op.kind in ("attach", "blobAttach"):
                 self._outbox.append(op)  # announcements replay verbatim

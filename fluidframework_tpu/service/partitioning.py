@@ -46,9 +46,13 @@ from ..qos.faults import (
 )
 from .local_orderer import LocalOrderer
 from .storage import (
+    CRC_KEY,
     DocumentStorage,
     atomic_write,
+    jsonl_record,
     read_offset_tolerant,
+    record_crc,
+    scrub_repair_jsonl,
 )
 
 # chaos seams (docs/ROBUSTNESS.md): the consume side replays a record
@@ -206,9 +210,13 @@ class FileOrderingQueue(OrderingQueue):
                 payload: dict) -> int:
         offset = self._counts[partition]
         with open(self._log_path(partition), "a") as f:
-            f.write(json.dumps(
+            # crc-stamped record (storage.jsonl_record): the consume
+            # path verifies it, so a bit-rotted queue record is
+            # detected (and scrub-repairable from a replica root)
+            # instead of sequencing garbage
+            f.write(jsonl_record(
                 {"document_id": document_id, "payload": payload}
-            ) + "\n")
+            ))
             if self.fsync:
                 f.flush()
                 os.fsync(f.fileno())
@@ -216,6 +224,8 @@ class FileOrderingQueue(OrderingQueue):
         return offset
 
     def read(self, partition: int, from_offset: int):
+        from .storage import CorruptRecordError
+
         path = self._log_path(partition)
         if not os.path.exists(path):
             return
@@ -235,6 +245,13 @@ class FileOrderingQueue(OrderingQueue):
                 if rec_offset < from_offset:
                     continue
                 data = json.loads(line)
+                if CRC_KEY in data and \
+                        data[CRC_KEY] != record_crc(data):
+                    raise CorruptRecordError(
+                        f"queue record {rec_offset} of partition "
+                        f"{partition} ({path!r}) failed its crc — "
+                        "bit rot; scrub-repair it from a replica "
+                        "root", path=path, index=rec_offset)
                 yield QueueRecord(
                     rec_offset, data["document_id"], data["payload"]
                 )
@@ -412,6 +429,49 @@ class ReplicatedFileOrderingQueue(FileOrderingQueue):
         for f in self.followers:
             f.commit(partition,
                      min(offset, f._counts[partition] - 1))
+
+    def scrub(self) -> int:
+        """Bit-rot scrub over every replica root's partition logs:
+        a record that fails its crc on one node is read-repaired from
+        any peer whose copy at the same offset is intact (the leader
+        included — quorum replication is what makes the repair
+        possible). Returns records repaired; raises
+        ``CorruptRecordError`` when no peer holds an intact copy."""
+        repaired = 0
+        nodes = [self] + list(self.followers)
+        for p in range(self.n_partitions):
+            for node in nodes:
+                path = node._log_path(p)
+                if not os.path.exists(path):
+                    continue
+
+                def fetch(index: int, rows: list,
+                          _node=node, _p=p) -> Optional[dict]:
+                    for peer in nodes:
+                        if peer is _node:
+                            continue
+                        try:
+                            for rec in peer.read(_p, index):
+                                return {
+                                    "document_id": rec.document_id,
+                                    "payload": rec.payload,
+                                }
+                        except ValueError:
+                            # CorruptRecordError (this peer rotted
+                            # too) or a raw json decode error (a
+                            # torn/garbled line on an fsync=False
+                            # peer): either way, try the next peer
+                            continue
+                    return None
+
+                report = scrub_repair_jsonl(path, "queue", fetch)
+                if report.repaired:
+                    # the rewrite replaced the inode: drop the
+                    # sequential-read cursor so the next read()
+                    # reopens at a valid byte position
+                    node._cursor.pop(p, None)
+                    repaired += report.repaired
+        return repaired
 
 
 class ReplicatedCheckpointManager:

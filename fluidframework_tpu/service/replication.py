@@ -60,7 +60,6 @@ lease service lapses the grant NOW — the split-brain trigger),
 """
 from __future__ import annotations
 
-import json
 import os
 import time
 from typing import Any, Optional
@@ -73,12 +72,14 @@ from ..qos.faults import (
     KIND_DEFER,
     KIND_DROP,
     KIND_ERROR,
+    KIND_HEAL,
+    KIND_PARTITION,
     PLANE,
 )
 from .local_orderer import LocalOrderer
 from .local_server import LocalServer
 from .storage import DocumentStorage, FileOpLog, atomic_write, \
-    read_jsonl_tolerant
+    jsonl_record, read_jsonl_tolerant, scrub_repair_jsonl
 
 # chaos seams (one schedule drives the document plane and the
 # partitioned-queue counterpart in partitioning.py — shared names,
@@ -91,6 +92,12 @@ _SITE_LEASE = PLANE.site("repl.lease_expire", (KIND_DROP, KIND_ERROR))
 # never acts on is exactly the vacuous vocabulary the sweep guard
 # exists to forbid
 _SITE_PROMOTE = PLANE.site("repl.promote", (KIND_ERROR,))
+# netsplit topology transitions: force()d by NetworkTopology when the
+# harness applies/heals a partition, so PLANE.fired stays the one
+# replayable log of everything that happened to the run (the torn-
+# state idiom: a topology change is a harness decision, not a draw)
+_SITE_PARTITION = PLANE.site("repl.partition", (KIND_PARTITION,))
+_SITE_HEAL = PLANE.site("repl.heal", (KIND_HEAL,))
 
 def _group_metrics(registry: obs_metrics.MetricsRegistry) -> dict:
     """Register (or fetch) the replication families on ``registry``.
@@ -118,6 +125,25 @@ def _group_metrics(registry: obs_metrics.MetricsRegistry) -> dict:
             "repl_anti_entropy_ops_total",
             "ops applied via anti-entropy catch-up and promotion "
             "suffix pulls"),
+        # partition-tolerance plane (quorum-loss degraded mode,
+        # follower lifecycle) — docs/OBSERVABILITY.md
+        "degraded": registry.gauge(
+            "repl_degraded",
+            "1 while the leader is in quorum-loss degraded mode "
+            "(writes nack retriable-unavailable; reads clamp at the "
+            "committed watermark)"),
+        "degraded_s": registry.counter(
+            "repl_degraded_seconds_total",
+            "cumulative seconds spent in degraded mode (accumulated "
+            "at degraded_exit, on the group clock)"),
+        "unavailable": registry.counter(
+            "repl_unavailable_nacks_total",
+            "writes refused with the retriable unavailable nack "
+            "while quorum/lease was unprovable"),
+        "rejoins": registry.counter(
+            "repl_rejoin_total",
+            "followers rejoined via full anti-entropy resync behind "
+            "the epoch fence"),
     }
 
 
@@ -150,6 +176,87 @@ class FencedWriteError(RuntimeError):
 class LeaseHeldError(RuntimeError):
     """Acquisition attempted while a live (unexpired) lease is held
     by another node."""
+
+
+class LeaseUnreachableError(RuntimeError):
+    """The lease service is in another reachability island: no grant
+    can be acquired or proven until the partition heals — elections
+    are impossible, which is exactly what keeps a split from minting
+    two leaders."""
+
+
+class QuorumUnavailableError(RuntimeError):
+    """The leader cannot prove a write durable (quorum unreachable
+    within the deadline) or cannot prove its own leadership (lease
+    lapsed with the lease service unreachable). RETRIABLE by
+    construction: nothing was sequenced as far as any client can
+    observe — the op stays with its submitter, rides a throttle nack
+    with ``shed_class="unavailable"``, and the PR9 reconnect/resubmit
+    path replays it after the heal."""
+
+    def __init__(self, msg: str, retry_after_seconds: float = 0.25):
+        super().__init__(msg)
+        self.retry_after_seconds = retry_after_seconds
+
+
+class NetworkTopology:
+    """Reachability islands for the in-process multi-node harnesses —
+    the netsplit fault vocabulary's state. Production deployments
+    never construct one (``group.network`` stays None = fully
+    connected, zero overhead); the chaos harness installs one and
+    drives ``partition()``/``heal()`` on the seeded schedule.
+
+    ``islands`` maps node id -> island index; nodes reach each other
+    iff they share an island, and the LEASE SERVICE occupies an
+    island of its own choosing (``lease_island``) so lease isolation
+    — everyone replicating fine but nobody able to renew or elect —
+    is expressible as its own split mode. Unknown nodes default to
+    island 0 (a node the schedule never mentioned is reachable from
+    the majority side)."""
+
+    def __init__(self, timeline=None):
+        self.islands: dict[str, int] = {}
+        self.lease_island = 0
+        self.timeline = timeline
+        self.split = False
+        self.flaps = 0
+
+    def island_of(self, node: str) -> int:
+        return self.islands.get(node, 0)
+
+    def reachable(self, a: str, b: str) -> bool:
+        return self.island_of(a) == self.island_of(b)
+
+    def lease_reachable(self, node: str) -> bool:
+        return self.island_of(node) == self.lease_island
+
+    def partition(self, groups: list[list[str]],
+                  lease_island: int = 0) -> None:
+        """Apply a split: ``groups[i]`` lands in island ``i``; the
+        lease service sits in ``groups[lease_island]``. Recorded
+        through the ``repl.partition`` site (PLANE.fired stays the
+        replayable log) and on the fleet timeline."""
+        self.islands = {node: i
+                        for i, group in enumerate(groups)
+                        for node in group}
+        self.lease_island = lease_island
+        if self.split:
+            self.flaps += 1
+        self.split = True
+        desc = "|".join(",".join(g) for g in groups)
+        _SITE_PARTITION.force(KIND_PARTITION, islands=desc,
+                              lease_island=lease_island)
+        _note(self.timeline, "partition", islands=desc,
+              lease_island=lease_island)
+
+    def heal(self) -> None:
+        if not self.split:
+            return
+        self.islands = {}
+        self.lease_island = 0
+        self.split = False
+        _SITE_HEAL.force(KIND_HEAL)
+        _note(self.timeline, "heal")
 
 
 class EpochFence:
@@ -196,11 +303,16 @@ class SequencerLease:
     (``error`` — the split-brain trigger)."""
 
     def __init__(self, fence: EpochFence, ttl: float = 0.3,
-                 clock=None, timeline=None):
+                 clock=None, timeline=None, network=None):
         self.fence = fence
         self.ttl = ttl
         self.clock = clock or time.monotonic
         self.timeline = timeline
+        # reachability to the lease SERVICE (netsplit plane): None =
+        # fully connected. An unreachable caller's renewal is lost in
+        # transit (the TTL keeps running) and its acquire refuses —
+        # a minority island can never mint an epoch
+        self.network: Optional[NetworkTopology] = network
         self.holder: Optional[str] = None
         self.expires_at = float("-inf")
 
@@ -212,6 +324,11 @@ class SequencerLease:
         return self.clock() >= self.expires_at
 
     def acquire(self, node_id: str) -> int:
+        if self.network is not None and \
+                not self.network.lease_reachable(node_id):
+            raise LeaseUnreachableError(
+                f"{node_id} cannot reach the lease service across "
+                "the partition: no election from a minority island")
         if self.holder not in (None, node_id) and not self.expired():
             raise LeaseHeldError(
                 f"lease held by {self.holder!r} for another "
@@ -225,6 +342,12 @@ class SequencerLease:
     def renew(self, node_id: str, epoch: int) -> bool:
         if node_id != self.holder or epoch != self.fence.epoch:
             return False  # deposed caller: the grant moved on
+        if self.network is not None and \
+                not self.network.lease_reachable(node_id):
+            # the renewal is lost in transit across the split: the
+            # TTL keeps running toward the lapse — topology-driven
+            # and deterministic, so it consumes NO chaos-site draw
+            return False
         fault = _SITE_LEASE.fire(holder=node_id)
         if fault == KIND_DROP:
             return False  # renewal lost in transit; TTL keeps running
@@ -291,7 +414,7 @@ class FollowerReplica:
             rows, torn = read_jsonl_tolerant(path, "repl")
             if torn:
                 atomic_write(path, "".join(
-                    json.dumps(r) + "\n" for r in rows))
+                    jsonl_record(r) for r in rows))
             if rows:
                 self._heads[doc] = rows[-1]["sequenceNumber"]
 
@@ -362,7 +485,9 @@ class FollowerReplica:
         _stamp(msg.traces, "repl", "follower_append",
                timestamp=self._stamp_ts() if self._stamp_ts else None)
         fh = self._fh(doc)
-        fh.write(json.dumps(message_to_json(msg)) + "\n")
+        # crc-stamped (storage.jsonl_record): the scrubber's bit-rot
+        # detection is only as good as the records carrying checksums
+        fh.write(jsonl_record(message_to_json(msg)))
         fh.flush()
         os.fsync(fh.fileno())  # durable BEFORE the ack counts
         self._heads[doc] = msg.sequence_number
@@ -455,8 +580,25 @@ class ReplicatedOpLog(FileOpLog):
         _stamp(msg.traces, "repl", "fence_check",
                timestamp=self._group._trace_ts())
         super()._persist_append(msg)  # local fsync (the PR9 barrier)
-        self._group.replicate_before_fanout(
-            self._doc, self._epoch, msg, self)
+        try:
+            self._group.replicate_before_fanout(
+                self._doc, self._epoch, msg, self)
+        except QuorumUnavailableError:
+            # quorum deadline lapsed: UNWIND the local append — in
+            # memory AND on disk — so the refused op can never be
+            # served, replicated later under a stale epoch, or leave
+            # the durable log ahead of the sequencer the submit path
+            # rolls back. The op was never quorum-durable, never
+            # fanned out; its submitter still holds it pending.
+            # Cycle the append handle around the rewrite (the
+            # _persist_truncate discipline): atomic_write replaces
+            # the inode, and a post-heal append through the stale
+            # handle would land on the unlinked file.
+            self._ops.pop()
+            self._fh.close()
+            self._rewrite()
+            self._fh = open(self.path, "a")
+            raise
 
     def truncate_below(self, seq: int) -> int:
         # summary truncation must never outrun a laggard: this log is
@@ -517,6 +659,14 @@ class ReplicatedLocalServer(LocalServer):
         def check(op: str = "write") -> None:
             self.group.fence.check(self.epoch, doc=document_id,
                                    op=op)
+            if op in ("submit", "connect", "disconnect"):
+                # the availability gate (quorum-loss degraded mode):
+                # AFTER the fence — a deposed leader refuses as
+                # deposed, never as "retry later". A refused
+                # disconnect is absorbed by the orderer as an OWED
+                # leave (settled at the client's next join), so
+                # teardown never detonates.
+                self.group.ensure_available(document_id, op=op)
         return check
 
     def read_ops(self, document_id: str, from_seq: int,
@@ -544,7 +694,11 @@ class ReplicatedSequencerGroup:
                  lease_ttl: float = 0.3, scope: str = "docs",
                  server_kwargs: Optional[dict] = None,
                  registry=None, follower_registries=None,
-                 timeline=None):
+                 timeline=None, network: Optional[NetworkTopology] = None,
+                 quorum_timeout_s: float = 0.5,
+                 retry_interval_s: float = 0.05,
+                 membership_grace_s: Optional[float] = None,
+                 sleep=None):
         if n_followers < 1:
             raise ValueError(
                 "a replicated sequencer needs at least one follower "
@@ -562,6 +716,22 @@ class ReplicatedSequencerGroup:
         # must never mix into wall-clock hop tables
         self._injected_clock = clock is not None
         self.clock = clock or time.monotonic
+        # the quorum barrier's wait primitive: deadline-bounded and
+        # INJECTABLE (qoscheck:unbounded-blocking-wait pins the
+        # deadline statically). Harnesses on the step clock inject a
+        # sleep that ADVANCES it, so the wait-out is deterministic;
+        # production defaults to the wall sleep.
+        self._sleep = sleep if sleep is not None else time.sleep
+        self.quorum_timeout_s = quorum_timeout_s
+        self.retry_interval_s = retry_interval_s
+        # follower unseen past the grace TTL -> membership shrinks
+        # (and grows back on rejoin); default: a few lease TTLs
+        self.membership_grace_s = membership_grace_s \
+            if membership_grace_s is not None else 4 * lease_ttl
+        # netsplit plane: None = fully connected (production; zero
+        # overhead). The chaos harness installs a NetworkTopology and
+        # drives partition()/heal() on the seeded schedule.
+        self.network = network
         self.registry = registry or obs_metrics.REGISTRY
         self.timeline = timeline
         self.metrics = _group_metrics(self.registry)
@@ -569,7 +739,7 @@ class ReplicatedSequencerGroup:
                                 timeline=timeline)
         self.lease = SequencerLease(self.fence, ttl=lease_ttl,
                                     clock=self.clock,
-                                    timeline=timeline)
+                                    timeline=timeline, network=network)
         self.followers = [
             FollowerReplica(
                 os.path.join(root, f"node-{i}"), f"node-{i}",
@@ -579,6 +749,21 @@ class ReplicatedSequencerGroup:
             )
             for i in range(1, n_followers + 1)
         ]
+        # quorum-loss degraded mode (read-only brownout) + follower
+        # lifecycle state. _degraded_probe_at paces rediscovery when
+        # NO topology is installed (production): one write per
+        # timeout window runs the barrier as the probe, the rest
+        # fast-nack — without it every post-loss write would re-pay
+        # the full discovery deadline.
+        self.degraded = False
+        self.degraded_reason = ""
+        self._degraded_since = 0.0
+        self._degraded_probe_at = 0.0
+        self._last_seen: dict[str, float] = {
+            f.node_id: self.clock() for f in self.followers}
+        #: detached (grace-lapsed / wiped) followers by node id —
+        #: rejoin() re-admits them behind the epoch fence
+        self.detached: dict[str, str] = {}
         # quorum over ALL nodes (leader included); default = a strict
         # majority of the initial group ((total // 2) + 1 — for even
         # group sizes too: 4 nodes need 3, or losing a minority could
@@ -623,19 +808,277 @@ class ReplicatedSequencerGroup:
         return min(f.head(doc) for f in self.followers) \
             if self.followers else self.committed(doc)
 
+    # -- quorum-loss degraded mode (read-only brownout) -----------------
+
+    def _reachable(self, f: FollowerReplica) -> bool:
+        return self.network is None or \
+            self.network.reachable(self.leader_id, f.node_id)
+
+    def _quorum_reachable(self) -> bool:
+        """ONE owner for the reachable-quorum verdict (leader + the
+        followers the topology can currently offer to), shared by the
+        pre-ticket gate and the barrier's cached-verdict fast path so
+        the two can never drift."""
+        return 1 + sum(
+            1 for f in self.followers if self._reachable(f)
+        ) >= self.quorum
+
+    def _lease_unprovable(self) -> bool:
+        """The leader's lease lapsed AND the lease service is across
+        the split: leadership cannot be proven, so writes must stop
+        (a write the node cannot prove it is entitled to sequence is
+        a fork candidate). A lapse with the lease service REACHABLE
+        is different — the next heartbeat renews it (same holder,
+        same epoch: the grant never moved)."""
+        return (self.lease.expired()
+                and self.network is not None
+                and not self.network.lease_reachable(self.leader_id))
+
+    def _enter_degraded(self, reason: str) -> None:
+        if self.degraded:
+            return
+        self.degraded = True
+        self.degraded_reason = reason
+        self._degraded_since = self.clock()
+        self._degraded_probe_at = self.clock() + self.quorum_timeout_s
+        self.metrics["degraded"].set(1)
+        _note(self.timeline, "degraded_enter", node=self.leader_id,
+              reason=reason)
+
+    def _exit_degraded(self) -> None:
+        if not self.degraded:
+            return
+        self.degraded = False
+        self.metrics["degraded"].set(0)
+        self.metrics["degraded_s"].inc(
+            max(0.0, self.clock() - self._degraded_since))
+        _note(self.timeline, "degraded_exit", node=self.leader_id,
+              reason=self.degraded_reason)
+        self.degraded_reason = ""
+        # the heal is also every unreachable follower's comeback:
+        # refresh liveness so the grace TTL restarts from the heal,
+        # not from the split
+        for f in self.followers:
+            if self._reachable(f):
+                self._last_seen[f.node_id] = self.clock()
+
+    def _refuse_unavailable(self, doc: str, op: str
+                            ) -> QuorumUnavailableError:
+        self.metrics["unavailable"].inc()
+        return QuorumUnavailableError(
+            f"quorum unavailable ({self.degraded_reason or 'quorum'}"
+            f"): {op} on {doc!r} refused — retriable; resubmit after "
+            "the partition heals (read-only brownout at the "
+            "committed watermark)",
+            retry_after_seconds=self.quorum_timeout_s)
+
+    def ensure_available(self, doc: str, op: str = "submit") -> None:
+        """The write path's pre-ticket availability gate (consulted by
+        the same write_fence hook as the epoch fence, AFTER it).
+        Degraded is a CACHED verdict: entered when the barrier timed
+        out (or the lease became unprovable), so exactly one submit
+        pays the discovery deadline and later ones fast-nack. Exit:
+        with a topology installed, the moment a probe shows quorum
+        reachable (and leadership provable) again; with NO topology
+        (production — reachability is only discoverable by trying),
+        one PACED probe write per timeout window runs the barrier as
+        the arbiter and a quorum success there exits degraded."""
+        if self._lease_unprovable():
+            self._enter_degraded("lease_unreachable")
+            raise self._refuse_unavailable(doc, op)
+        if not self.degraded:
+            return
+        if self.network is not None:
+            if self._quorum_reachable():
+                self._exit_degraded()
+                return
+            raise self._refuse_unavailable(doc, op)
+        if self.clock() >= self._degraded_probe_at:
+            self._degraded_probe_at = \
+                self.clock() + self.quorum_timeout_s
+            return  # the probe write: the barrier decides
+        raise self._refuse_unavailable(doc, op)
+
+    # -- follower lifecycle (grace shrink, rejoin) ----------------------
+
+    def detach(self, node_id: str, origin: str) -> Optional[str]:
+        """THE membership-shrink path (grace lapse, or a crash-and-
+        wipe observed as a dead host being replaced — both callers
+        share it so the quorum rule can never drift between them):
+        the follower leaves the membership, the quorum recomputes as
+        a strict majority of the REMAINING set (floored at 2 — at
+        least one follower must hold every fanned-out op — and
+        clamped to what the remaining set can satisfy). The data dir
+        stays on disk; ``rejoin()`` re-admits the node. Returns the
+        detached root, or None when the node is unknown or the last
+        follower (never shrink below one)."""
+        f = next((x for x in self.followers
+                  if x.node_id == node_id), None)
+        if f is None or len(self.followers) <= 1:
+            return None
+        self.followers.remove(f)
+        self.detached[node_id] = f.root
+        f.close()
+        self.quorum = min(
+            self.quorum,
+            max(2, (1 + len(self.followers)) // 2 + 1))
+        self.quorum = min(self.quorum, 1 + len(self.followers))
+        self.metrics["followers"].labels(
+            partition=self.scope).set(len(self.followers))
+        _note(self.timeline, "membership", node=node_id,
+              action="shrink", origin=origin, quorum=self.quorum,
+              followers=len(self.followers))
+        return f.root
+
+    def _check_membership_grace(self) -> None:
+        """Followers unseen past the grace TTL detach (see
+        :meth:`detach`)."""
+        cutoff = self.clock() - self.membership_grace_s
+        for f in list(self.followers):
+            if self._last_seen.get(f.node_id, cutoff) >= cutoff:
+                continue
+            self.detach(f.node_id, origin="grace")
+
+    def _leader_log(self, doc: str):
+        """The leader's op log for ``doc``, booting the orderer from
+        its durable dir when it has not been touched since a
+        promotion (``server.documents`` is lazy; booting from the dir
+        IS the crash-restore path). None when the leader holds
+        nothing for the doc."""
+        if self.server is None:
+            return None
+        if doc not in self.server.documents and not os.path.isdir(
+                os.path.join(self.server.durable_dir, doc)):
+            return None
+        return self.server.get_orderer(doc).op_log
+
+    def rejoin(self, node_id: str, registry=None) -> FollowerReplica:
+        """Re-admit a crashed (possibly WIPED) follower: a fresh
+        replica over its dir, fenced at the current epoch, fully
+        resynced by anti-entropy from every peer's contiguous log
+        (follower logs are never truncated, so one surviving peer
+        covers a wiped node's whole history) plus the leader's log
+        tail. Membership grows back and the quorum recomputes."""
+        root = self.detached.pop(node_id, None) or \
+            os.path.join(self.root, node_id)
+        f = FollowerReplica(root, node_id, registry=registry,
+                            timeline=self.timeline,
+                            stamp_ts=self._trace_ts)
+        f.note_epoch(self.fence.epoch)  # the fence: stale writers out
+        docs = set()
+        for peer in self.followers:
+            docs.update(peer.documents())
+        if self.server is not None:
+            docs.update(self.server.documents)
+            docs.update(
+                d for d in os.listdir(self.server.durable_dir)
+                if os.path.isdir(
+                    os.path.join(self.server.durable_dir, d)))
+        applied = 0
+        for doc in sorted(docs):
+            for peer in self.followers:
+                if peer.head(doc) > f.head(doc):
+                    applied += f.sync_from(
+                        doc, peer.read_log(doc, f.head(doc)))
+            log = self._leader_log(doc)
+            if log is not None:
+                behind = [m for m in log.read(f.head(doc))
+                          if m.sequence_number <= self.committed(doc)]
+                applied += f.sync_from(doc, behind)
+        if applied:
+            self.metrics["anti_entropy"].inc(applied)
+        self.followers.append(f)
+        self._last_seen[node_id] = self.clock()
+        self.quorum = max(self.quorum,
+                          (1 + len(self.followers)) // 2 + 1)
+        self.metrics["followers"].labels(partition=self.scope).set(
+            len(self.followers))
+        self.metrics["rejoins"].inc()
+        _note(self.timeline, "rejoin", node=node_id,
+              ops_resynced=applied, quorum=self.quorum,
+              followers=len(self.followers))
+        return f
+
+    # -- bit-rot scrubbing ---------------------------------------------
+
+    def scrub(self) -> int:
+        """Scrub every follower's replica logs: a record that fails
+        its crc is read-repaired from any peer (other followers, then
+        the leader's op log) whose copy is intact — quorum
+        replication is what makes the repair possible. Returns
+        records repaired (storage_scrub_repairs_total counts them
+        per log); raises ``CorruptRecordError`` when NO intact copy
+        survives anywhere."""
+        repaired = 0
+        for f in self.followers:
+            for doc in f.documents():
+                path = f._log_path(doc)
+                if not os.path.isfile(path):
+                    continue
+
+                def fetch(index: int, rows: list, _doc=doc,
+                          _f=f) -> Optional[dict]:
+                    from .storage import CorruptRecordError
+
+                    # contiguous follower logs start at seq 1, so an
+                    # intact neighbour anchors the corrupt slot's seq
+                    seq = None
+                    for j, row in enumerate(rows):
+                        if row is not None and "sequenceNumber" in row:
+                            seq = row["sequenceNumber"] + (index - j)
+                            break
+                    if seq is None:
+                        seq = index + 1
+                    for peer in self.followers:
+                        if peer is _f:
+                            continue
+                        try:
+                            for m in peer.read_log(_doc, seq - 1):
+                                if m.sequence_number == seq:
+                                    return message_to_json(m)
+                                break
+                        except CorruptRecordError:
+                            continue  # this peer rotted too: next
+                    log = self._leader_log(_doc)
+                    if log is not None:
+                        for m in log.read(seq - 1, seq):
+                            if m.sequence_number == seq:
+                                return message_to_json(m)
+                    return None
+
+                report = scrub_repair_jsonl(path, "repl", fetch)
+                if report.repaired:
+                    # the rewrite replaced the inode: reopen the
+                    # append handle or later appends land on the
+                    # unlinked file
+                    fh = f._fhs.pop(doc, None)
+                    if fh is not None:
+                        fh.close()
+                    repaired += report.repaired
+                    _note(self.timeline, "scrub_repair",
+                          node=f.node_id, doc=doc,
+                          records=report.repaired)
+        return repaired
+
     # -- the ack barrier ------------------------------------------------
 
     def replicate_before_fanout(self, doc: str, epoch: int,
                                 msg: SequencedMessage,
                                 source_log) -> None:
-        """Block until ``msg`` is durable on a quorum. Callers check
-        the epoch fence FIRST (qoscheck:fence-before-fanout pins the
-        ordering statically). Follower faults are absorbed — the
-        quorum is the contract, not any single ack: a lagging or
-        unreachable follower simply doesn't count, and when the
-        prompt acks fall short the barrier force-syncs laggards in
-        deterministic order (the leader genuinely WAITS on its
-        quorum, exactly what an ack barrier means)."""
+        """Block until ``msg`` is durable on a quorum — but never
+        forever: the wait is DEADLINE-BOUNDED on the injectable
+        clock. Callers check the epoch fence FIRST
+        (qoscheck:fence-before-fanout pins the ordering statically).
+        Follower faults are absorbed — the quorum is the contract,
+        not any single ack: a lagging follower is force-synced in
+        deterministic order; an UNREACHABLE one (netsplit) simply
+        cannot ack, and when the deadline lapses with the quorum
+        still short the append is UNWOUND (the op was never
+        client-visible) and the leader enters degraded mode,
+        refusing the write with a retriable unavailable nack — a
+        minority-side leader nacks its submitters instead of hanging
+        them (qoscheck:unbounded-blocking-wait pins the deadline
+        statically)."""
         seq = msg.sequence_number
         # the hop pair around the quorum barrier: forward marks the
         # leader offering the op to its followers, quorum_ack marks
@@ -645,20 +1088,63 @@ class ReplicatedSequencerGroup:
         # repl_quorum_wait_ms from exactly this pair)
         _stamp(msg.traces, "repl", "forward",
                timestamp=self._trace_ts())
+        if self.degraded:
+            # the verdict is CACHED: while a topology says the
+            # partition stands, a write that slipped past the gate
+            # (a leave, a mid-batch op) must not pay the discovery
+            # deadline again — refuse immediately. Otherwise this is
+            # the PACED probe write (or a topology-observed heal):
+            # run the barrier, and a quorum success below is what
+            # exits degraded.
+            if self.network is not None:
+                if not self._quorum_reachable():
+                    raise self._refuse_unavailable(doc, "append")
+                self._exit_degraded()
         acked = 1  # the leader's own fsynced append
         for f in self.followers:
             if self._offer(f, doc, epoch, msg, source_log):
                 acked += 1
         # leadership heartbeat piggybacks on replication traffic
         self.lease.renew(self.leader_id, epoch)
-        if acked < self.quorum:
+        deadline = self.clock() + self.quorum_timeout_s
+        # attempts bound the wait even under a mis-injected clock (a
+        # harness whose sleep forgets to advance it): the barrier
+        # degrades to a bounded retry count, never a busy spin
+        attempts = 0
+        max_attempts = max(1, int(
+            self.quorum_timeout_s / max(self.retry_interval_s, 1e-9)
+        ) + 1)
+        while acked < self.quorum:
             for f in self.followers:
                 if acked >= self.quorum:
                     break
-                if f.head(doc) >= seq:
+                if f.head(doc) >= seq or not self._reachable(f):
                     continue
                 self._force_sync(f, doc, epoch, msg, source_log)
                 acked += 1
+            if acked >= self.quorum:
+                break
+            if self.clock() >= deadline or attempts >= max_attempts:
+                # quorum shortfall past the deadline: unwind + refuse
+                # (the fix for the unbounded `while acked < quorum`
+                # wait — a vanished follower set cannot hang a
+                # submitter). The local append rolls back (the op was
+                # never quorum-durable, never fanned out; its
+                # submitter still holds it pending), degraded mode
+                # latches so later submits fast-nack, and the nack is
+                # retriable — the PR9 resubmit path converges after
+                # the heal.
+                self._enter_degraded("quorum_timeout")
+                raise self._refuse_unavailable(doc, "append")
+            self._sleep(self.retry_interval_s)
+            attempts += 1
+        if self.degraded:
+            # the paced probe write reached quorum: the loss healed
+            self._exit_degraded()
+        self._last_seen.update(
+            (f.node_id, self.clock())
+            for f in self.followers if f.head(doc) >= seq)
+        self._check_membership_grace()
         heads = sorted([seq] + [f.head(doc) for f in self.followers],
                        reverse=True)
         self._committed[doc] = max(self.committed(doc),
@@ -673,10 +1159,15 @@ class ReplicatedSequencerGroup:
     def _offer(self, f: FollowerReplica, doc: str, epoch: int,
                msg: SequencedMessage, source_log) -> bool:
         """One replication attempt to one follower; True = durable
-        ack. ``defer`` buffers (replication lag); a dropped/erroring
-        ack is retried once (the broker-append idiom), then the
-        follower just misses this round — catch-up repairs it on the
-        next offer or at promotion."""
+        ack. An unreachable follower (netsplit) cannot be offered
+        anything — and consumes NO chaos-site draw, so the injection
+        stream stays a pure function of the reachable event order.
+        ``defer`` buffers (replication lag); a dropped/erroring ack
+        is retried once (the broker-append idiom), then the follower
+        just misses this round — catch-up repairs it on the next
+        offer or at promotion."""
+        if not self._reachable(f):
+            return False
         seq = msg.sequence_number
         if _SITE_LAG.fire(follower=f.node_id, doc=doc,
                           seq=seq) == KIND_DEFER:
@@ -743,10 +1234,18 @@ class ReplicatedSequencerGroup:
         if not self.followers:
             raise RuntimeError("no followers left to promote")
         if candidate is None:
-            # max() keeps the FIRST maximum: deterministic low-index
-            # tie-break
-            candidate = max(self.followers,
-                            key=lambda f: f.total_head())
+            # only a candidate that can reach the lease service can
+            # be elected (acquire() enforces it; a minority island
+            # never mints an epoch). max() keeps the FIRST maximum:
+            # deterministic low-index tie-break.
+            eligible = [f for f in self.followers
+                        if self.network is None
+                        or self.network.lease_reachable(f.node_id)]
+            if not eligible:
+                raise LeaseUnreachableError(
+                    "no follower can reach the lease service across "
+                    "the partition: no election until the heal")
+            candidate = max(eligible, key=lambda f: f.total_head())
         fault = _SITE_PROMOTE.fire(node=candidate.node_id)
         if fault == KIND_ERROR:
             # transient election failure: the retry is exact (nothing
@@ -759,11 +1258,19 @@ class ReplicatedSequencerGroup:
                  ) -> ReplicatedLocalServer:
         # 1) the candidate's own received-but-buffered tail
         candidate.flush_lag()
-        # 2) anti-entropy from every surviving peer: any fanned-out op
-        # is durable on >= quorum-1 followers, so at least one
-        # surviving peer holds it in its contiguous prefix
+        # 2) anti-entropy from every surviving REACHABLE peer: any
+        # fanned-out op is durable on >= quorum-1 followers, so at
+        # least one surviving peer holds it in its contiguous prefix
+        # (a peer across a netsplit cannot be read — the in-process
+        # object is right there, but pulling from it would model an
+        # impossible cross-split transfer; the heal-time catch-up
+        # repairs whatever it holds)
         for peer in self.followers:
             if peer is candidate:
+                continue
+            if self.network is not None and not \
+                    self.network.reachable(candidate.node_id,
+                                           peer.node_id):
                 continue
             for doc in peer.documents():
                 if peer.head(doc) > candidate.head(doc):

@@ -49,12 +49,14 @@ import json
 import os
 import sys
 import time
-from typing import Any, Optional
+import zlib
+from typing import Any, Callable, Optional
 
 from ..obs import metrics as obs_metrics
 from ..protocol.messages import SequencedMessage
 from ..protocol.serialization import message_from_json, message_to_json
 from ..qos.faults import (
+    KIND_CORRUPT,
     KIND_ERROR,
     KIND_ERROR_BURST,
     KIND_TORN_WRITE,
@@ -69,6 +71,10 @@ _M_TORN = obs_metrics.REGISTRY.counter(
     "storage_torn_recoveries_total",
     "torn on-disk states discarded on load (crash recovery)",
     labelnames=("file",))
+_M_SCRUB = obs_metrics.REGISTRY.counter(
+    "storage_scrub_repairs_total",
+    "bit-rotted records read-repaired from a quorum peer, by log",
+    labelnames=("file",))
 
 # chaos seams (docs/ROBUSTNESS.md): the checkpoint write consults its
 # site per write (error faults exercise the storage breaker); the
@@ -79,6 +85,11 @@ _SITE_CHECKPOINT = PLANE.site(
     "storage.checkpoint_write",
     (KIND_ERROR, KIND_ERROR_BURST, KIND_TORN_WRITE))
 _SITE_OPLOG = PLANE.site("storage.oplog_append", (KIND_TORN_WRITE,))
+# bit rot: a record's bytes flip at rest (a disk sector going bad, not
+# a crash). force()d by the harness when it plants corruption — like
+# the torn states, the injection is a harness decision the plane
+# records, never a mid-run fault draw
+_SITE_BITROT = PLANE.site("storage.bitrot", (KIND_CORRUPT,))
 
 
 def atomic_write(path: str, data: str) -> None:
@@ -109,11 +120,62 @@ def atomic_write(path: str, data: str) -> None:
         os.close(dfd)
 
 
+CRC_KEY = "_crc"
+
+
+def record_crc(row: dict) -> int:
+    """Per-record checksum over the CANONICAL encoding (sorted keys,
+    tight separators) of the row WITHOUT its own crc field — so the
+    crc survives a round trip through any JSON re-encoder."""
+    return zlib.crc32(_canonical(
+        {k: v for k, v in row.items() if k != CRC_KEY}))
+
+
+def jsonl_record(row: dict) -> str:
+    """One CRC-stamped JSONL line (op logs, replica logs, queue record
+    logs). The crc rides as an OPTIONAL field — the PR4/PR6 interop
+    discipline: readers verify it when present and accept legacy rows
+    without one, so pre-existing logs keep loading."""
+    return json.dumps(dict(row, **{CRC_KEY: record_crc(row)})) + "\n"
+
+
+class CorruptRecordError(ValueError):
+    """A record whose bytes are wrong AT REST — a crc mismatch, or a
+    malformed line that is not the torn tail. NOT a crash state: the
+    write barriers rule those out, so this is bit rot (or an operator
+    mishap) and must either be read-repaired from a quorum peer
+    (:func:`scrub_repair_jsonl`) or fail loudly — never served."""
+
+    def __init__(self, msg: str, path: str = "", index: int = -1):
+        super().__init__(msg)
+        self.path = path
+        self.index = index  # 0-based record index in the file
+
+
+def _check_record_crc(row: dict, label: str, path: str,
+                      line_no: int) -> dict:
+    """Verify (and strip) an optional per-record crc; raises
+    :class:`CorruptRecordError` on mismatch."""
+    if CRC_KEY not in row:
+        return row  # legacy record: nothing to verify
+    want = row[CRC_KEY]
+    got = record_crc(row)
+    if want != got:
+        raise CorruptRecordError(
+            f"{label} crc mismatch at line {line_no} of {path!r}: "
+            f"stored {want}, computed {got} — bit rot, not a crash "
+            "state; scrub-repair it from a quorum peer "
+            "(docs/ROBUSTNESS.md)", path=path, index=line_no - 1)
+    return {k: v for k, v in row.items() if k != CRC_KEY}
+
+
 def read_jsonl_tolerant(path: str, label: str) -> tuple[list, bool]:
     """Parse a JSONL file tolerating ONE torn final line (the crash-
     mid-append state). Returns (parsed rows, tail_was_torn). A
-    malformed line anywhere but the end is corruption, not a crash
-    state — raised, never skipped."""
+    malformed line anywhere but the end — or a crc mismatch ANYWHERE,
+    tail included (a completed fsynced write whose bytes changed is
+    rot, not a tear) — is corruption, not a crash state: raised,
+    never skipped."""
     rows: list = []
     with open(path) as f:
         lines = f.readlines()
@@ -122,12 +184,13 @@ def read_jsonl_tolerant(path: str, label: str) -> tuple[list, bool]:
         if not line:
             continue
         try:
-            rows.append(json.loads(line))
+            row = json.loads(line)
         except ValueError:
             if any(stripped[i + 1:]):
-                raise ValueError(
+                raise CorruptRecordError(
                     f"{label} corrupt at line {i + 1} of {path!r}: "
-                    "a non-tail torn record is not a crash state"
+                    "a non-tail torn record is not a crash state",
+                    path=path, index=i,
                 )
             _M_TORN.labels(file=label).inc()
             print(
@@ -137,7 +200,95 @@ def read_jsonl_tolerant(path: str, label: str) -> tuple[list, bool]:
                 file=sys.stderr,
             )
             return rows, True
+        rows.append(_check_record_crc(row, label, path, i + 1))
     return rows, False
+
+
+# ----------------------------------------------------------------------
+# the scrubber: detect bit rot per record, read-repair from peers
+
+
+@dataclasses.dataclass
+class ScrubReport:
+    """One log's scrub outcome. ``corrupt`` holds the 0-based record
+    indexes that failed their crc (or tore mid-file); ``torn_tail``
+    is the PR9-recoverable crash state — left for the loader's
+    torn-tail discard, NOT treated as rot."""
+
+    path: str
+    records: int = 0
+    torn_tail: bool = False
+    corrupt: list = dataclasses.field(default_factory=list)
+    repaired: int = 0
+
+
+def _scan_jsonl(path: str) -> tuple[list, list[Optional[dict]],
+                                    ScrubReport]:
+    """(raw lines, parsed rows with None at corrupt slots, report)."""
+    report = ScrubReport(path=path)
+    with open(path) as f:
+        lines = [ln for ln in f.readlines() if ln.strip()]
+    rows: list[Optional[dict]] = []
+    for i, line in enumerate(lines):
+        try:
+            row = json.loads(line)
+        except ValueError:
+            if i == len(lines) - 1:
+                report.torn_tail = True
+                rows.append(None)
+                continue
+            report.corrupt.append(i)
+            rows.append(None)
+            continue
+        if CRC_KEY in row and row[CRC_KEY] != record_crc(row):
+            report.corrupt.append(i)
+            rows.append(None)
+            continue
+        rows.append({k: v for k, v in row.items() if k != CRC_KEY})
+    report.records = len(lines)
+    return lines, rows, report
+
+
+def scrub_jsonl(path: str, label: str) -> ScrubReport:
+    """Detect-only pass: classify every record as intact, bit-rotted
+    (``corrupt``), or the torn tail."""
+    _, _, report = _scan_jsonl(path)
+    return report
+
+
+def scrub_repair_jsonl(
+        path: str, label: str,
+        fetch: Callable[[int, list], Optional[dict]]) -> ScrubReport:
+    """Read-repair: every corrupt record is replaced by the row
+    ``fetch(index, rows)`` supplies (a quorum peer's copy — ``rows``
+    gives the caller the intact neighbours to anchor identity, e.g.
+    a contiguous op log's sequence numbers). A torn TAIL is left
+    byte-for-byte for the loader's PR9 discard. ``fetch`` returning
+    None means no surviving peer holds the record: raised loudly —
+    a quorum-acked record with zero intact copies is data loss, and
+    pretending otherwise would serve garbage."""
+    lines, rows, report = _scan_jsonl(path)
+    if not report.corrupt:
+        return report
+    out: list[str] = []
+    for i, (line, row) in enumerate(zip(lines, rows)):
+        if i in report.corrupt:
+            repaired = fetch(i, rows)
+            if repaired is None:
+                raise CorruptRecordError(
+                    f"{label} record {i} of {path!r} is corrupt and "
+                    "no surviving peer holds an intact copy — "
+                    "unrepairable bit rot", path=path, index=i)
+            out.append(jsonl_record(
+                {k: v for k, v in repaired.items() if k != CRC_KEY}))
+            report.repaired += 1
+            _M_SCRUB.labels(file=label).inc()
+        elif row is None:
+            out.append(line)  # the torn tail, verbatim
+        else:
+            out.append(jsonl_record(row))
+    atomic_write(path, "".join(out))
+    return report
 
 
 def read_offset_tolerant(path: str, label: str = "offset") -> int:
@@ -358,7 +509,9 @@ class FileOpLog(OpLog):
         self._fh = open(path, "a")
 
     def _persist_append(self, msg: SequencedMessage) -> None:
-        self._fh.write(json.dumps(message_to_json(msg)) + "\n")
+        # crc-stamped record (jsonl_record): load + scrub verify it,
+        # so a sector flipping at rest is DETECTED instead of served
+        self._fh.write(jsonl_record(message_to_json(msg)))
         self._fh.flush()
         # the ACK BARRIER: the pipeline fans out (and acks) only after
         # this returns, so an op any client ever saw sequenced is
@@ -373,7 +526,7 @@ class FileOpLog(OpLog):
 
     def _rewrite(self) -> None:
         atomic_write(self.path, "".join(
-            json.dumps(message_to_json(m)) + "\n" for m in self._ops
+            jsonl_record(message_to_json(m)) for m in self._ops
         ))
 
 

@@ -259,6 +259,11 @@ class ChaosDocumentService:
             self.transport.die()
         self.transport = ChaosTransport(
             self.harness.server, f"{self.client_name}")
+        # register at CREATION, not on connect success: a transport
+        # opened by a refused join (the degraded window) must still be
+        # abandoned by a later leader swap, or a quiesce-time reuse
+        # would read from the DEPOSED server through it
+        self.harness.register_transport(self)
         return self.transport
 
     def _transport_died(self) -> None:
@@ -441,6 +446,7 @@ class ChaosHarness:
         self.server: Optional[AlfredServer] = None
         self.sidecar = None
         self.group = None  # ReplicatedSequencerGroup when replicated
+        self.network = None  # NetworkTopology when replicated
         self.crashes = 0
         self.failovers = 0
         # fleet observability (replicated runs): per-NODE registries
@@ -476,9 +482,13 @@ class ChaosHarness:
             reset_timeout_s=0.2, clock=self.clock,
         )
         if self.replicated:
-            from ..service.replication import ReplicatedSequencerGroup
+            from ..service.replication import (
+                NetworkTopology,
+                ReplicatedSequencerGroup,
+            )
 
             if self.group is None:
+                self.network = NetworkTopology(timeline=self.timeline)
                 self.group = ReplicatedSequencerGroup(
                     self.durable_dir, n_followers=self.n_followers,
                     clock=self.clock, lease_ttl=0.3,
@@ -488,6 +498,18 @@ class ChaosHarness:
                         for i in range(1, self.n_followers + 1)
                     ],
                     timeline=self.timeline,
+                    # the netsplit plane: islands the seeded plan
+                    # drives, a SHORT quorum deadline (0.2s = 4 retry
+                    # ticks on the step clock, so unavailability
+                    # discovery costs one submit, not the run), the
+                    # grace TTL for membership shrink, and a sleep
+                    # that ADVANCES the step clock — the barrier's
+                    # deadline wait is deterministic per seed
+                    network=self.network,
+                    quorum_timeout_s=0.2,
+                    retry_interval_s=0.05,
+                    membership_grace_s=0.4,
+                    sleep=self._advance_clock,
                     server_kwargs=dict(
                         checkpoint_every=self.checkpoint_every,
                         storage_breaker=breaker,
@@ -684,6 +706,122 @@ class ChaosHarness:
         for msg in self.server.local.read_ops(DOC_BETA, 0):
             self.sidecar.ingest(DOC_BETA, msg)
 
+    def _advance_clock(self, dt: float) -> None:
+        """The quorum barrier's injectable sleep: waiting out the
+        deadline ADVANCES the step clock, so a partition's discovery
+        cost is deterministic per seed."""
+        self.clock.t += dt
+
+    def load_container(self, document_id: str, client_name: str,
+                       client_id: str) -> Container:
+        """Container.load with the harness bindings: the throttle-nack
+        backoff clock rides the STEP clock (a wall-clock backoff
+        would make `flush()`'s reconnect gate depend on how fast the
+        test machine runs — the exact nondeterminism the config9
+        discipline forbids)."""
+        c = Container.load(self.service_for(document_id, client_name),
+                           client_id=client_id)
+        c._backoff_clock = self.clock
+        return c
+
+    # -- netsplits (the partition-tolerance plane) ----------------------
+
+    def apply_netsplit(self, mode: str) -> None:
+        """Apply one enumerated split (SPLIT_MODES). Island layouts
+        are STATIC node-name lists — a mid-run leadership change does
+        not move the islands, exactly like a real partition."""
+        assert self.group is not None, "netsplits need replicated="
+        if mode in ("symmetric", "flap"):
+            self.network.partition(
+                [["node-0", "node-1"], ["node-2"]], lease_island=0)
+        elif mode == "minority_leader":
+            # the leader alone on the minority side; the LEASE
+            # SERVICE sits with the majority, so the lease lapses and
+            # the majority can elect while the minority leader can
+            # only nack (and is fenced after the election)
+            self.network.partition(
+                [["node-0"], ["node-1", "node-2"]], lease_island=1)
+        elif mode == "lease_isolated":
+            # everyone replicates fine; NOBODY reaches the lease
+            # service — no renewals, no elections: past the TTL the
+            # leader cannot prove leadership and steps into the
+            # read-only brownout until the heal
+            self.network.partition(
+                [["node-0", "node-1", "node-2"], []], lease_island=1)
+        else:
+            raise ValueError(f"unknown netsplit mode {mode!r}")
+
+    def heal_netsplit(self) -> None:
+        if self.network is not None:
+            self.network.heal()
+
+    def wipe_follower(self, node_id: str) -> None:
+        """Crash-and-WIPE a follower: its process dies and its disk is
+        gone (the dir is deleted). Detached immediately through the
+        group's shared shrink path — the grace TTL covers
+        reachability loss; a wipe is observed as a dead host being
+        replaced — and re-admitted by ``rejoin_follower`` via full
+        anti-entropy from a surviving full-history peer."""
+        g = self.group
+        f = next(x for x in g.followers if x.node_id == node_id)
+        f._heads.clear()
+        f._lag.clear()
+        root = g.detach(node_id, origin="wipe")
+        assert root is not None, f"{node_id} was not detachable"
+        shutil.rmtree(root, ignore_errors=True)
+
+    def rejoin_follower(self, node_id: str) -> None:
+        self.group.rejoin(
+            node_id, registry=self.node_registries.get(node_id))
+
+    def elect_majority(self) -> None:
+        """The majority side's election during a minority-leader
+        split: the lapsed lease is observed, the best-replicated
+        majority follower is promoted (it can reach the lease
+        service; the minority leader cannot), and the deposed
+        minority leader keeps running — every write still driven
+        through it must be refused by the epoch fence until it
+        rejoins as a follower after the heal."""
+        self.group.failover()
+        self.failovers += 1
+
+    def bitrot_and_scrub(self) -> int:
+        """Plant one mid-file bit-rot state (a parseable record whose
+        crc no longer matches — recorded through the storage.bitrot
+        site) in the first follower log with enough records, then run
+        the group scrubber: the record must be read-repaired from a
+        quorum peer. Returns records repaired."""
+        from ..qos.faults import KIND_CORRUPT
+        from ..service.storage import _SITE_BITROT
+
+        g = self.group
+        for f in g.followers:
+            for doc in f.documents():
+                path = f._log_path(doc)
+                if not os.path.isfile(path):
+                    continue
+                with open(path) as fh_r:
+                    lines = fh_r.readlines()
+                if len(lines) < 3:
+                    continue
+                # corrupt a NEAR-TAIL record (not the tail): the
+                # leader's log always still covers it (summary
+                # truncation can drop the head), so a quorum copy
+                # exists even when this is the only follower
+                idx = len(lines) - 2
+                row = json.loads(lines[idx])
+                row["contents"] = {"bitrot": True}  # stale _crc kept
+                lines[idx] = json.dumps(row) + "\n"
+                fh = f._fhs.pop(doc, None)
+                if fh is not None:
+                    fh.close()
+                with open(path, "w") as fh_w:
+                    fh_w.writelines(lines)
+                _SITE_BITROT.force(KIND_CORRUPT, node=f.node_id,
+                                   doc=doc, record=idx)
+                return g.scrub()
+        return 0
+
     def _apply_tear(self, tear: str,
                     containers: list[Container]) -> bool:
         """Apply one torn crash state; returns whether it actually
@@ -777,6 +915,15 @@ class ChaosReport:
     kill_mode: Optional[str] = None
     fenced_writes: int = 0
     repl_lag_max: int = 0
+    # netsplit runs (run_chaos_netsplit): the partition-tolerance
+    # surface — all step-clock/seed deterministic
+    netsplit_mode: Optional[str] = None
+    partitions: int = 0
+    heals: int = 0
+    unavailable_nacks: int = 0
+    degraded_s: float = 0.0
+    rejoins: int = 0
+    scrub_repairs: int = 0
     # fleet observability (replicated runs): the causal timeline's
     # event sequence and the federated per-node counter totals —
     # both step-clock/seed deterministic, both in
@@ -807,6 +954,13 @@ class ChaosReport:
             "kill_mode": self.kill_mode,
             "fenced_writes": self.fenced_writes,
             "repl_lag_max": self.repl_lag_max,
+            "netsplit_mode": self.netsplit_mode,
+            "partitions": self.partitions,
+            "heals": self.heals,
+            "unavailable_nacks": self.unavailable_nacks,
+            "degraded_s": round(self.degraded_s, 6),
+            "rejoins": self.rejoins,
+            "scrub_repairs": self.scrub_repairs,
             "timeline_events": list(self.timeline_events),
             "fleet_counters": dict(self.fleet_counters),
             "broker_ops": self.broker_ops,
@@ -856,6 +1010,58 @@ def failover_plan(seed: int, n_steps: int) -> tuple[Optional[int],
     return step, mode
 
 
+SPLIT_MODES = ("minority_leader", "symmetric", "lease_isolated",
+               "flap", "wipe_rejoin")
+
+
+def netsplit_plan(seed: int, n_steps: int) -> dict:
+    """The netsplit differential's schedule as a PURE function of the
+    seed (the crash_plan/failover_plan discipline): which of the five
+    enumerated split modes applies, when it splits and heals, whether
+    the seed additionally crash-restarts the leader (odd seeds —
+    placed where each mode makes a takeover legal: mid-split when a
+    majority-side election can run, at/after the heal when it cannot),
+    and when the bit-rot scrub-repair leg runs (every seed, after the
+    heal, so a quorum peer exists). The mode cycles with
+    (seed%5 + 2*(seed//5)) — the stride-2 block offset is what makes
+    any seed range [0, 20) cover every mode in BOTH parities (a
+    stride-1 cycle kept wipe_rejoin on even seeds only, so the
+    wipe+crash combination was silently never swept).
+
+    Mode shapes:
+
+    - ``minority_leader`` — the leader alone vs the majority (lease
+      with the majority): degraded nacks on the minority side, a
+      majority election when the TTL lapses, the deposed leader
+      fenced, and a post-heal REJOIN of the old leader as a follower.
+    - ``symmetric`` — leader+one follower vs the other: quorum holds,
+      the isolated follower detaches on the grace TTL and rejoins at
+      the heal.
+    - ``lease_isolated`` — the lease service in its own island:
+      replication fine, leadership unprovable past the TTL →
+      read-only brownout, healed by the first post-heal renewal.
+    - ``flap`` — the symmetric split applying/healing every 2 steps.
+    - ``wipe_rejoin`` — a follower crashes AND loses its disk;
+      rejoin is a full anti-entropy resync from a surviving
+      full-history peer behind the epoch fence.
+    """
+    mode = SPLIT_MODES[(seed % 5 + 2 * (seed // 5)) % 5]
+    split = n_steps // 2 - 2 + (seed % 3)
+    heal = split + 10
+    crash = None
+    if seed % 2 == 1:
+        if mode in ("symmetric", "flap"):
+            crash = split + 3      # mid-split: majority can elect
+        elif mode == "lease_isolated":
+            crash = heal           # elections impossible mid-split
+        elif mode == "wipe_rejoin":
+            crash = heal + 1       # after the wiped node resynced
+        # minority_leader: the mid-split majority election IS the
+        # leadership change this mode exists to prove
+    return {"mode": mode, "split": split, "heal": heal,
+            "crash": crash, "scrub": heal + 3}
+
+
 _ALPHA_TAGS = ("A", "B", "C")
 
 
@@ -887,7 +1093,8 @@ def run_chaos(seed: int, faults: bool = True,
               n_steps: int = 40, workload_seed: int = 1234,
               durable_dir: Optional[str] = None,
               sites: Optional[list[str]] = None,
-              replicated: bool = False) -> ChaosReport:
+              replicated: bool = False,
+              netsplit: bool = False) -> ChaosReport:
     """One chaos run: scripted workload, seeded schedule, optional
     crash-restart, quiesce, convergence checks. ``faults=False`` is
     the fault-free oracle (same workload, nothing armed, no crash).
@@ -902,7 +1109,7 @@ def run_chaos(seed: int, faults: bool = True,
     try:
         _run_chaos_into(report, seed, faults, n_steps,
                         workload_seed, durable_dir, sites,
-                        replicated=replicated)
+                        replicated=replicated, netsplit=netsplit)
     finally:
         if PLANE.armed:
             PLANE.disarm()
@@ -920,6 +1127,28 @@ def run_chaos(seed: int, faults: bool = True,
         "sequencer_fenced_writes_total", 0))
     report.converged = not report.failures
     return report
+
+
+def run_chaos_netsplit(seed: int, faults: bool = True,
+                       n_steps: int = 40,
+                       workload_seed: int = 1234,
+                       durable_dir: Optional[str] = None,
+                       sites: Optional[list[str]] = None
+                       ) -> ChaosReport:
+    """THE netsplit differential entry point: the same scripted
+    workload over the replicated plane, with ``netsplit_plan(seed)``
+    splitting the network mid-run (all five enumerated split modes,
+    odd seeds additionally crash-restarting the leader) and a bit-rot
+    scrub-repair leg after the heal. ``faults=False`` is the
+    replicated fault-free oracle — identical to
+    ``run_chaos_failover(faults=False)``, so the sweep pins equality
+    against the same oracle chain (netsplit ≡ failover oracle ≡
+    plain-plane oracle). A failing seed reproduces alone:
+    ``run_chaos_netsplit(seed)``."""
+    return run_chaos(seed, faults=faults, n_steps=n_steps,
+                     workload_seed=workload_seed,
+                     durable_dir=durable_dir, sites=sites,
+                     replicated=True, netsplit=True)
 
 
 def run_chaos_failover(seed: int, faults: bool = True,
@@ -946,10 +1175,16 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
                     n_steps: int, workload_seed: int,
                     durable_dir: str,
                     sites: Optional[list[str]],
-                    replicated: bool = False) -> None:
+                    replicated: bool = False,
+                    netsplit: bool = False) -> None:
     harness = ChaosHarness(durable_dir, replicated=replicated)
     wl = random.Random(workload_seed)  # the SAME script for any seed
-    if replicated:
+    ns: Optional[dict] = None
+    if netsplit:
+        crash_step, tear = None, None
+        kill_step, kill_mode = None, None
+        ns = netsplit_plan(seed, n_steps) if faults else None
+    elif replicated:
         crash_step, tear = None, None
         kill_step, kill_mode = failover_plan(seed, n_steps) \
             if faults else (None, None)
@@ -959,12 +1194,13 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
         kill_step, kill_mode = None, None
     report.tear = tear if crash_step is not None else None
     report.kill_mode = kill_mode if kill_step is not None else None
+    report.netsplit_mode = ns["mode"] if ns else None
 
     # --- setup (pre-arm): regions + channels, everyone synced --------
     writers: list[Container] = []
     for i, tag in enumerate(_ALPHA_TAGS):
-        svc = harness.service_for(DOC_ALPHA, f"alpha-{tag}")
-        writers.append(Container.load(svc, client_id=f"client-{tag}"))
+        writers.append(harness.load_container(
+            DOC_ALPHA, f"alpha-{tag}", f"client-{tag}"))
     ds = writers[0].runtime.create_datastore("app")
     ds.create_channel("sharedstring", "text")
     ds.create_channel("sharedmap", "kv")
@@ -972,8 +1208,7 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
     text0.insert_text(0, "[A][B][C][Z]")
     writers[0].flush()
     harness.pump()
-    beta_svc = harness.service_for(DOC_BETA, "beta-W")
-    beta = Container.load(beta_svc, client_id="client-W")
+    beta = harness.load_container(DOC_BETA, "beta-W", "client-W")
     bds = beta.runtime.create_datastore("app")
     bds.create_channel("sharedstring", "text")
     beta.flush()
@@ -1032,6 +1267,8 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
             btext.insert_text(pos, f"w{beta_serial:03d}.")
 
     # --- the scripted main loop --------------------------------------
+    ns_elected = False
+    ns_swap_step: Optional[int] = None
     for step in range(n_steps):
         harness.clock.t += 0.05
         # reconnects due this step (transport deaths + crash)
@@ -1040,8 +1277,59 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
             if step >= when:
                 del down_until[i]
                 if not c.connected and not c.closed:
-                    c.connect()
-                    report.reconnects += 1
+                    if not _connect_maybe(c, report,
+                                          guarded=ns is not None):
+                        # still inside the degraded window: the join
+                        # was refused retriably — stay down, retry on
+                        # the jittered schedule
+                        down_until[i] = step + 1 + \
+                            reconnect_rng.randrange(3)
+        # --- netsplit schedule (netsplit_plan: split/heal/crash/scrub)
+        if ns is not None:
+            if step == ns["split"]:
+                if ns["mode"] == "wipe_rejoin":
+                    harness.wipe_follower("node-2")
+                else:
+                    harness.apply_netsplit(ns["mode"])
+            if ns["mode"] == "flap" and \
+                    ns["split"] < step < ns["heal"] and \
+                    (step - ns["split"]) % 2 == 0:
+                # flapping: the same split toggling every 2 steps
+                if harness.network.split:
+                    harness.heal_netsplit()
+                else:
+                    harness.apply_netsplit("flap")
+            if (ns["mode"] == "minority_leader" and not ns_elected
+                    and harness.network.split
+                    and harness.group.lease.expired()):
+                # the MAJORITY side observes the lapse and elects;
+                # this step's flushes still drive the deposed
+                # minority leader — every one must be fenced
+                harness.elect_majority()
+                ns_elected = True
+                ns_swap_step = step + 1
+            if ns_swap_step is not None and step == ns_swap_step:
+                ns_swap_step = None
+                harness.complete_leader_swap()
+                for j in range(len(all_containers)):
+                    down_until[j] = step + 1 + \
+                        reconnect_rng.randrange(3)
+            if step == ns["heal"]:
+                harness.heal_netsplit()
+                if ns["mode"] == "minority_leader":
+                    # the deposed old leader rejoins as a follower
+                    harness.rejoin_follower("node-0")
+                if ns["mode"] == "wipe_rejoin" or \
+                        "node-2" in harness.group.detached:
+                    # wiped, or grace-detached during the split
+                    harness.rejoin_follower("node-2")
+            if ns["crash"] is not None and step == ns["crash"]:
+                harness.kill_leader("clean")
+                for j in range(len(all_containers)):
+                    down_until[j] = step + 1 + \
+                        reconnect_rng.randrange(3)
+            if step == ns["scrub"]:
+                report.scrub_repairs += harness.bitrot_and_scrub()
         kill_now = kill_step is not None and step == kill_step
         if kill_now and kill_mode == "under_lag":
             # make replication lag REAL before the kill: the next
@@ -1076,7 +1364,7 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
                        wl.randrange(1000))
             # else: think (flush below still runs)
             _safe_flush(c, all_containers, down_until, i, step,
-                        reconnect_rng)
+                        reconnect_rng, guarded=ns is not None)
             if kill_now and kill_mode == "mid_batch" and i == 0:
                 # kill MID-BATCH: writer A's flush is sequenced and
                 # replicated; B, C and beta flush into a dead plane
@@ -1088,7 +1376,7 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
         beta_edit()
         beta_edit()
         _safe_flush(beta, all_containers, down_until, 3, step,
-                    reconnect_rng)
+                    reconnect_rng, guarded=ns is not None)
         if kill_now and kill_mode in ("clean", "under_lag"):
             # kill AFTER the step's flushes, BEFORE their pump — the
             # crash-plan timing: the just-sequenced fanout frames die
@@ -1222,6 +1510,20 @@ def _run_chaos_into(report: ChaosReport, seed: int, faults: bool,
         # federated totals instead of the process-wide delta
         report.fenced_writes = int(report.fleet_counters.get(
             "sequencer_fenced_writes_total", 0))
+        # netsplit surface: topology transitions from the replayable
+        # PLANE.fired log, unavailability/lifecycle from the
+        # federated per-node counters — all step-clock deterministic
+        report.partitions = sum(
+            1 for site, _, _ in PLANE.fired
+            if site == "repl.partition")
+        report.heals = sum(
+            1 for site, _, _ in PLANE.fired if site == "repl.heal")
+        report.unavailable_nacks = int(report.fleet_counters.get(
+            "repl_unavailable_nacks_total", 0))
+        report.degraded_s = round(float(report.fleet_counters.get(
+            "repl_degraded_seconds_total", 0.0)), 6)
+        report.rejoins = int(report.fleet_counters.get(
+            "repl_rejoin_total", 0))
     report.acked_ops = acked_box[0]
     # PLANE.fired is reset by arm(): an unarmed (oracle) run must
     # report [] — not whatever sequence a PREVIOUS armed run left
@@ -1243,9 +1545,50 @@ def _note_down(containers, down_until: dict, i: int, step: int,
         down_until[i] = step + 1 + rng.randrange(3)
 
 
+def _retriable_refusal(e: Exception) -> bool:
+    """Is this exception the degraded/deposed plane refusing a
+    client, as a real driver would see it? The chaos transport
+    reconstructs server-side errors as plain RuntimeError/
+    PermissionError from the error frame TEXT, so the typed
+    exceptions are not catchable here — match the refusal wording
+    instead (narrow on purpose: an unrelated RuntimeError in the
+    same code path must stay LOUD, or the differential would absorb
+    real bugs as reschedules)."""
+    if isinstance(e, ConnectionError):
+        return True  # transport died mid-refusal: retriable
+    text = str(e)
+    return ("quorum unavailable" in text
+            or "epoch fence" in text
+            or "connect_document rejected" in text)
+
+
+def _connect_maybe(c: Container, report, guarded: bool = False) -> bool:
+    """Reconnect a client; ``guarded`` (netsplit runs) absorbs a
+    RETRIABLE refusal — the degraded window refuses the reconnect's
+    JOIN with the unavailable error, exactly as a real driver would
+    see it, and the harness retries on its jittered schedule. Outside
+    a netsplit run a refused connect stays LOUD."""
+    try:
+        c.connect()
+    except (PermissionError, ConnectionError, RuntimeError) as e:
+        if not guarded or not _retriable_refusal(e):
+            raise
+        return False
+    if hasattr(report, "reconnects"):  # the storm report has none
+        report.reconnects += 1
+    return True
+
+
 def _safe_flush(c: Container, containers, down_until, i, step,
-                rng) -> None:
-    c.flush()
+                rng, guarded: bool = False) -> None:
+    try:
+        c.flush()
+    except (PermissionError, ConnectionError, RuntimeError) as e:
+        # flush()'s own reconnect-after-nack ran into the degraded
+        # window's join refusal: pending edits stay pending, the
+        # client stays down and the harness reschedules it
+        if not guarded or not _retriable_refusal(e):
+            raise
     if not c.connected:
         _note_down(containers, down_until, i, step, rng)
 
@@ -1380,6 +1723,16 @@ class ChaosStormReport:
     # asserted bit-equal across config12's x2 storm runs
     failover_phases: Optional[dict] = None
     fleet_metrics: dict = field(default_factory=dict)
+    # netsplit leg (--netsplit / config13): the leader loses its
+    # quorum mid-storm and must NACK, not hang — unavailability_s is
+    # the degraded window (degraded_enter -> degraded_exit on the
+    # step clock) and degraded_read_s runs until the first post-heal
+    # ack lands (reads were clamped at the stale committed watermark
+    # for that whole span)
+    netsplit_window: Optional[tuple] = None
+    unavailability_s: Optional[float] = None
+    degraded_read_s: Optional[float] = None
+    unavailable_nacks: int = 0
 
     def deterministic_fields(self) -> dict:
         return {
@@ -1395,6 +1748,10 @@ class ChaosStormReport:
             "repl_lag_max": self.repl_lag_max,
             "failover_phases": dict(self.failover_phases or {}),
             "fleet_metrics": dict(self.fleet_metrics),
+            "netsplit_window": self.netsplit_window,
+            "unavailability_s": self.unavailability_s,
+            "degraded_read_s": self.degraded_read_s,
+            "unavailable_nacks": self.unavailable_nacks,
         }
 
 
@@ -1402,7 +1759,8 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
                     storm: tuple[int, int] = (40, 80),
                     window: int = 8, slo_target: float = 0.95,
                     sites: Optional[list[str]] = None,
-                    kill_leader_step: Optional[int] = None
+                    kill_leader_step: Optional[int] = None,
+                    netsplit: Optional[tuple[int, int]] = None
                     ) -> ChaosStormReport:
     """Three phases on one step clock: steady (faults off), STORM
     (the standard schedule armed), recovery (faults off again).
@@ -1422,7 +1780,16 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
     started counting only after the kill step ended) and decomposes
     it into ``failover_phases`` (detection / anti-entropy /
     promotion / first-ack, summing to failover_time_s exactly);
-    ``fleet_metrics`` carries the federated per-node snapshot."""
+    ``fleet_metrics`` carries the federated per-node snapshot.
+
+    ``netsplit=(lo, hi)`` instead runs the storm over the replicated
+    plane and partitions the LEADER away from both followers (lease
+    service staying with the leader: no election, pure quorum loss)
+    for that step window: writes nack retriable-unavailable for the
+    whole split — the plane must brown out, not hang — and the
+    report carries ``unavailability_s`` (the degraded window) and
+    ``degraded_read_s`` (until the first post-heal ack) next to
+    ``goodput_dip``, bench config13's headline numbers."""
     import re
     import tempfile
 
@@ -1434,22 +1801,34 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
         raise ValueError(
             f"kill_leader_step {kill_leader_step} outside the run's "
             f"step range [0, {steps})")
-    report = ChaosStormReport(seed=seed, steps=steps,
-                              storm_steps=storm,
-                              kill_leader_step=kill_leader_step)
+    if netsplit is not None:
+        if kill_leader_step is not None:
+            raise ValueError(
+                "--netsplit and --kill-leader are separate storm "
+                "modes; run them as separate storms")
+        lo_hi_ok = 0 <= netsplit[0] < netsplit[1] < steps
+        if not lo_hi_ok:
+            raise ValueError(
+                f"netsplit window {netsplit} outside the run's step "
+                f"range [0, {steps}) or empty")
+    report = ChaosStormReport(
+        seed=seed, steps=steps, storm_steps=storm,
+        kill_leader_step=kill_leader_step,
+        netsplit_window=tuple(netsplit) if netsplit else None)
     before = obs_metrics.REGISTRY.flat()
     durable = tempfile.mkdtemp(prefix="fftpu-chaos-storm-")
-    harness = ChaosHarness(durable,
-                           replicated=kill_leader_step is not None)
+    harness = ChaosHarness(
+        durable,
+        replicated=kill_leader_step is not None
+        or netsplit is not None)
     wl = random.Random(4242)
     schedule = standard_schedule(seed, sites)
     reconnect_rng = schedule.rng_for("reconnect")
     try:
         writers: list[Container] = []
         for i, tag in enumerate(_ALPHA_TAGS):
-            svc = harness.service_for(DOC_ALPHA, f"alpha-{tag}")
-            writers.append(
-                Container.load(svc, client_id=f"client-{tag}"))
+            writers.append(harness.load_container(
+                DOC_ALPHA, f"alpha-{tag}", f"client-{tag}"))
         ds = writers[0].runtime.create_datastore("app")
         ds.create_channel("sharedstring", "text")
         ds.create_channel("sharedmap", "kv")
@@ -1480,6 +1859,7 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
         rolling: list[tuple[int, int]] = []
         post_storm_ok = 0
         storm_lo, storm_hi = storm
+        first_post_heal_ack_t: Optional[float] = None
         for step in range(steps):
             harness.clock.t += 0.05
             if step == storm_lo:
@@ -1493,12 +1873,25 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
                 # head; writers reconnect and the step clock from
                 # kill to first post-failover ack is failover_time_s
                 harness.kill_leader("clean")
+            if netsplit is not None and step == netsplit[0]:
+                # quorum loss, measured: the leader alone (lease on
+                # ITS side — no election, pure brownout); every
+                # write until the heal must nack, not hang
+                harness.network.partition(
+                    [["node-0"], ["node-1", "node-2"]],
+                    lease_island=0)
+            if netsplit is not None and step == netsplit[1]:
+                harness.network.heal()
             for i, when in list(down_until.items()):
                 if step >= when:
                     del down_until[i]
                     c = writers[i]
                     if not c.connected and not c.closed:
-                        c.connect()
+                        if not _connect_maybe(
+                                c, report,
+                                guarded=netsplit is not None):
+                            down_until[i] = step + 1 + \
+                                reconnect_rng.randrange(3)
             offered = 0
             acked = 0
             for i, c in enumerate(writers):
@@ -1510,10 +1903,14 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
                 offered += 1
                 _region_edit(c, _ALPHA_TAGS[i], serials[i], wl)
                 _safe_flush(c, writers, down_until, i, step,
-                            reconnect_rng)
+                            reconnect_rng,
+                            guarded=netsplit is not None)
             harness.pump()
             acked = sum(acked_total) - acked_prev
             acked_prev = sum(acked_total)
+            if (netsplit is not None and step >= netsplit[1]
+                    and acked and first_post_heal_ack_t is None):
+                first_post_heal_ack_t = harness.clock.t
             if (kill_leader_step is not None
                     and step >= kill_leader_step
                     and report.failover_time_s is None and acked):
@@ -1584,6 +1981,38 @@ def run_chaos_storm(seed: int = 0, steps: int = 120,
                     "no ack ever landed after the leader kill — "
                     "failover never completed")
                 report.converged = False
+            if netsplit is not None:
+                # the netsplit leg's headline numbers, off the fleet
+                # timeline (all step-clock): unavailability_s = the
+                # degraded window; degraded_read_s = degraded_enter
+                # until the first post-heal ack (reads were clamped
+                # at the stale committed watermark the whole span)
+                enters = [e for e in harness.timeline.events()
+                          if e.kind == "degraded_enter"]
+                exits = [e for e in harness.timeline.events()
+                         if e.kind == "degraded_exit"]
+                if not enters or not exits:
+                    report.failures.append(
+                        "netsplit window never entered/exited "
+                        "degraded mode — the split tested nothing")
+                    report.converged = False
+                else:
+                    report.unavailability_s = round(sum(
+                        x.t - e.t for e, x in zip(enters, exits)), 6)
+                    if first_post_heal_ack_t is None:
+                        report.failures.append(
+                            "no ack ever landed after the heal")
+                        report.converged = False
+                    else:
+                        report.degraded_read_s = round(
+                            first_post_heal_ack_t - enters[0].t, 6)
+                totals = harness.fleet.counter_totals()
+                report.unavailable_nacks = int(totals.get(
+                    "repl_unavailable_nacks_total", 0))
+                if report.unavailable_nacks == 0:
+                    report.failures.append(
+                        "netsplit fired no unavailable nacks")
+                    report.converged = False
         # arm() reset PLANE.fired at storm start, so the count is
         # this storm's own; a run whose window never armed reports 0
         report.fired = len(PLANE.fired) if steps > storm_lo else 0

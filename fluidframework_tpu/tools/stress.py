@@ -447,10 +447,28 @@ def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
                              "and repl_lag_max next to goodput_dip "
                              "— a failing failover seed reproduces "
                              "from this CLI alone")
+    parser.add_argument("--netsplit", type=int, default=None,
+                        metavar="SEED",
+                        help="run the seeded storm over the "
+                             "REPLICATED plane with the leader "
+                             "partitioned away from its quorum for "
+                             "the middle half of the storm window: "
+                             "writes must nack retriable-"
+                             "unavailable, never hang; reports "
+                             "unavailability_s and degraded_read_s "
+                             "next to goodput_dip and the chaos "
+                             "counts — a failing netsplit seed "
+                             "reproduces from this CLI alone")
     args = parser.parse_args(argv)
     if args.kill_leader is not None and args.chaos is None:
         parser.error("--kill-leader requires --chaos SEED")
-    if args.chaos is not None:
+    if args.netsplit is not None and args.kill_leader is not None:
+        parser.error("--netsplit and --kill-leader are separate "
+                     "storm modes; run them as separate storms")
+    if args.netsplit is not None and args.chaos is not None:
+        parser.error("--netsplit runs its own seeded storm; drop "
+                     "--chaos (the --netsplit value IS the seed)")
+    if args.chaos is not None or args.netsplit is not None:
         from ..testing.chaos import run_chaos_storm
 
         kill_step = args.kill_leader
@@ -461,11 +479,25 @@ def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
             parser.error(
                 f"--kill-leader {kill_step} outside the step range "
                 f"[0, {args.chaos_steps})")
+        netsplit_window = None
+        if args.netsplit is not None:
+            lo, hi = args.chaos_storm
+            quarter = max(1, (hi - lo) // 4)
+            netsplit_window = (lo + quarter, hi - quarter)
+            if not (0 <= netsplit_window[0] < netsplit_window[1]
+                    < args.chaos_steps):
+                parser.error(
+                    f"netsplit window {netsplit_window} (middle "
+                    f"half of the storm {args.chaos_storm}) falls "
+                    f"outside the step range [0, {args.chaos_steps})")
         report = run_chaos_storm(
-            seed=args.chaos, steps=args.chaos_steps,
+            seed=args.chaos if args.chaos is not None
+            else args.netsplit,
+            steps=args.chaos_steps,
             storm=tuple(args.chaos_storm),
             sites=args.sites.split(",") if args.sites else None,
             kill_leader_step=kill_step,
+            netsplit=netsplit_window,
         )
         print(json.dumps({
             "seed": report.seed,
@@ -486,6 +518,14 @@ def main(argv: Optional[list[str]] = None) -> int:  # pragma: no cover
             "fleet_metrics": report.fleet_metrics,
             "failovers": report.failovers,
             "repl_lag_max": report.repl_lag_max,
+            # the netsplit leg (quorum-loss degraded mode): how long
+            # the plane browned out, and how long reads stayed
+            # clamped at the stale committed watermark
+            "netsplit_window": list(report.netsplit_window)
+            if report.netsplit_window else None,
+            "unavailability_s": report.unavailability_s,
+            "degraded_read_s": report.degraded_read_s,
+            "unavailable_nacks": report.unavailable_nacks,
             "converged": report.converged,
             "failures": report.failures,
             "fired": report.fired,

@@ -32,11 +32,14 @@ from fluidframework_tpu.qos.faults import (
 )
 from fluidframework_tpu.testing.chaos import (
     KILL_MODES,
+    SPLIT_MODES,
     ChaosHarness,
     crash_plan,
     failover_plan,
+    netsplit_plan,
     run_chaos,
     run_chaos_failover,
+    run_chaos_netsplit,
     run_chaos_storm,
     standard_schedule,
 )
@@ -201,6 +204,122 @@ def _check_timeline_causality(report, detail: str) -> None:
         "sequencer_failovers_total", 0) == report.failovers, detail
 
 
+# ----------------------------------------------------------------------
+# the netsplit differential (partition-tolerant replication plane)
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_netsplit_convergence_differential(seed, failover_oracle):
+    """The partition-tolerance acceptance: 20 seeded netsplit
+    schedules — all five enumerated split modes (minority-leader,
+    symmetric, lease isolation, flap, wipe+rejoin), odd seeds
+    additionally crash-restarting the leader, every seed planting a
+    mid-file bit-rot state that the scrubber must read-repair — each
+    bit-identical to the fault-free oracle (which itself equals the
+    plain-plane oracle, pinned by the failover_oracle fixture). A
+    failing seed reproduces alone: ``run_chaos_netsplit(seed)``."""
+    report = run_chaos_netsplit(seed)
+    plan = netsplit_plan(seed, 40)
+    detail = (
+        f"seed {seed} (reproduce: run_chaos_netsplit({seed})), "
+        f"mode={plan['mode']}@{plan['split']}-{plan['heal']}, "
+        f"crash={plan['crash']}, nacks={report.unavailable_nacks}, "
+        f"degraded_s={report.degraded_s}, rejoins={report.rejoins}, "
+        f"scrub={report.scrub_repairs}: {report.failures}"
+    )
+    assert report.converged, detail
+    assert len(report.fired) > 0, f"seed {seed}: no faults fired"
+    _sweep_record(report)
+    assert report.netsplit_mode == plan["mode"]
+    # every seed's split actually applied and healed (wipe_rejoin has
+    # no network event — its "split" is the wiped node)
+    if plan["mode"] != "wipe_rejoin":
+        assert report.partitions >= 1 and report.heals >= 1, detail
+    # the mode-specific contract actually exercised. (Brownout nacks
+    # are GUARANTEED only for lease isolation: in minority_leader the
+    # armed schedule's own lease_expire:error fault can lapse the
+    # lease just before the split, making the majority election legal
+    # immediately — a faster takeover, not a vacuous run, because the
+    # fencing + rejoin half still must fire.)
+    if plan["mode"] == "lease_isolated":
+        assert report.unavailable_nacks > 0, (
+            f"{detail}: lease isolation must brown the plane out — "
+            "zero unavailable nacks means the mode tested nothing")
+        assert report.degraded_s > 0, detail
+    if plan["mode"] == "minority_leader":
+        # the majority elected, the deposed minority leader stayed
+        # fenced, and it rejoined as a follower after the heal
+        assert report.failovers >= 1, detail
+        assert report.fenced_writes > 0, detail
+        assert report.rejoins >= 1, detail
+    if plan["mode"] == "wipe_rejoin":
+        assert report.rejoins >= 1, detail
+    # the bit-rot leg: one planted mid-file flip, read-repaired
+    assert report.scrub_repairs >= 1, detail
+    # bit-identical to the fault-free oracle: partitions may brown
+    # the plane out, but the ORDER any client observed survives
+    assert report.alpha_text == failover_oracle.alpha_text, detail
+    assert report.alpha_kv == failover_oracle.alpha_kv, detail
+    assert report.beta_text == failover_oracle.beta_text, detail
+
+
+def test_netsplit_plan_covers_every_split_mode():
+    """Structural: within the N seeds, every enumerated split mode
+    appears in BOTH parities (odd = crash-restarting), so the sweep
+    provably covers mode x crash (netsplit_plan is a pure function
+    of the seed)."""
+    plans = [netsplit_plan(seed, 40) for seed in range(N_SEEDS)]
+    modes = {p["mode"] for p in plans}
+    assert modes == set(SPLIT_MODES), modes
+    crashing = {p["mode"] for p in plans if p["crash"] is not None}
+    # minority_leader's takeover is the mid-split election itself;
+    # every other mode must appear with a crash-restart
+    assert crashing >= set(SPLIT_MODES) - {"minority_leader"}, crashing
+    assert all(p["split"] < p["heal"] < 40 for p in plans)
+
+
+def test_netsplit_runs_are_deterministic():
+    # seed 11: minority_leader — election + fencing + rejoin, the
+    # hairiest mode
+    a = run_chaos_netsplit(11)
+    b = run_chaos_netsplit(11)
+    assert a.fired == b.fired
+    assert a.deterministic_fields() == b.deterministic_fields()
+
+
+def test_netsplit_timeline_is_causally_ordered():
+    """The new timeline kinds ride the same causality contract:
+    degraded_enter precedes its degraded_exit and follows the
+    partition (the lease-isolation seed — its brownout is
+    deterministic), every rejoin follows the heal (the
+    minority-leader seed — its rejoin is deterministic), and the
+    scrub-repair records reconcile with the report on both."""
+    brown = run_chaos_netsplit(2)   # lease_isolated
+    events = brown.timeline_events  # (seq, t, node, kind, fields)
+    kinds = [e[3] for e in events]
+    assert "partition" in kinds and "heal" in kinds
+    assert "degraded_enter" in kinds and "degraded_exit" in kinds
+    enter = next(e for e in events if e[3] == "degraded_enter")
+    exit_ = next(e for e in events if e[3] == "degraded_exit")
+    assert enter[0] < exit_[0] and enter[1] <= exit_[1]
+    part = next(e for e in events if e[3] == "partition")
+    assert part[0] < enter[0], (
+        "degraded mode cannot causally precede the partition")
+
+    minority = run_chaos_netsplit(0)  # minority_leader
+    events = minority.timeline_events
+    rejoins = [e for e in events if e[3] == "rejoin"]
+    assert len(rejoins) == minority.rejoins >= 1
+    heal = next(e for e in events if e[3] == "heal")
+    assert all(r[0] > heal[0] for r in rejoins), (
+        "a rejoin cannot causally precede the heal")
+    for report in (brown, minority):
+        scrubs = [e for e in report.timeline_events
+                  if e[3] == "scrub_repair"]
+        assert sum(dict(e[4]).get("records", 0) for e in scrubs) == \
+            report.scrub_repairs
+
+
 def test_seed_range_covers_every_kill_mode():
     """Structural: within the N seeds, every enumerated kill mode
     (clean host loss, mid-batch, promotion under lag, deposed race)
@@ -266,6 +385,7 @@ def test_sites_registered_at_every_seam():
         "ingress.summary_upload",
         "repl.lag", "repl.append_ack",
         "repl.lease_expire", "repl.promote",
+        "repl.partition", "repl.heal", "storage.bitrot",
     } <= names
 
 
@@ -635,6 +755,66 @@ def test_chaos_storm_kill_leader_measures_failover():
     assert a.deterministic_fields() == b.deterministic_fields()
 
 
+def test_chaos_storm_netsplit_browns_out_and_recovers():
+    """The storm over the replicated plane with the leader
+    partitioned away from its quorum mid-storm: every write inside
+    the window nacks retriable-unavailable (the plane browns out,
+    never hangs), acks resume after the heal, and unavailability_s /
+    degraded_read_s land next to goodput_dip — bit-equal across runs
+    (config13's contract)."""
+    a = run_chaos_storm(seed=13, steps=90, storm=(30, 60),
+                        netsplit=(38, 52))
+    assert a.converged, a.failures
+    assert a.unavailable_nacks > 0
+    assert a.unavailability_s is not None and a.unavailability_s > 0
+    assert a.degraded_read_s is not None and \
+        a.degraded_read_s >= a.unavailability_s - 1e-9
+    assert a.goodput_dip == 0.0, (
+        "a quorum-lost leader must shed EVERY write in the window")
+    assert a.recovery_steps is not None, (
+        "goodput must recover after the heal")
+    assert a.failovers == 0, "no election: the lease stayed home"
+    b = run_chaos_storm(seed=13, steps=90, storm=(30, 60),
+                        netsplit=(38, 52))
+    assert a.deterministic_fields() == b.deterministic_fields()
+
+
+def test_stress_cli_netsplit_mode():
+    """A failing netsplit seed must reproduce from the CLI alone:
+    tools/stress --netsplit SEED."""
+    from fluidframework_tpu.tools import stress
+
+    rc, out = _run_cli(stress, ["--netsplit", "5",
+                                "--chaos-steps", "60",
+                                "--chaos-storm", "20", "40"])
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload["converged"] is True
+    assert payload["netsplit_window"] == [25, 35]  # middle half
+    assert payload["unavailability_s"] > 0
+    assert payload["degraded_read_s"] is not None
+    assert payload["unavailable_nacks"] > 0
+    assert payload["failover_time_s"] is None  # no election
+
+    # usage-error discipline (mirrors --kill-leader): the modes are
+    # mutually exclusive, and --netsplit carries its own seed
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stderr(buf), pytest.raises(SystemExit):
+        stress.main(["--netsplit", "1", "--chaos", "1"])
+    buf = io.StringIO()
+    with contextlib.redirect_stderr(buf), pytest.raises(SystemExit):
+        stress.main(["--chaos", "1", "--netsplit", "1",
+                     "--kill-leader"])
+    with pytest.raises(ValueError):
+        run_chaos_storm(seed=1, steps=60, netsplit=(50, 70))
+    with pytest.raises(ValueError):
+        run_chaos_storm(seed=1, steps=60, storm=(20, 40),
+                        kill_leader_step=30, netsplit=(25, 35))
+
+
 def test_stress_cli_kill_leader_mode():
     """A failing failover seed must reproduce from the CLI alone:
     tools/stress --chaos SEED --kill-leader [STEP]."""
@@ -795,13 +975,13 @@ SWEEP_EXEMPT = {
 
 
 def test_sweep_fires_every_registered_site():
-    """Every injection site registered on the PLANE during the two
+    """Every injection site registered on the PLANE during the three
     20-seed sweeps fired at least once across them (test.* fixture
     sites and the audited SWEEP_EXEMPT contract aside). A new seam
     whose site never fires under the standard schedule fails HERE —
     vacuous chaos coverage is a bug, not a gap."""
-    if len(_SWEEP_RUNS) < 2 * N_SEEDS:
-        pytest.skip("needs the full 2x20-seed sweep in this session")
+    if len(_SWEEP_RUNS) < 3 * N_SEEDS:
+        pytest.skip("needs the full 3x20-seed sweep in this session")
     auditable = {
         name for name in _SWEEP_SITES
         if not name.startswith("test.")
@@ -816,6 +996,9 @@ def test_sweep_fires_every_registered_site():
     assert stale == [], (
         f"stale SWEEP_EXEMPT entries (they DO fire now): {stale}")
     # the repl seams specifically must be live in the sweep — the
-    # tentpole's own coverage can never go vacuous silently
+    # tentpole's own coverage can never go vacuous silently; the
+    # netsplit sweep adds the topology transitions + the planted
+    # bit-rot state to that contract
     assert {"repl.lag", "repl.append_ack", "repl.lease_expire",
-            "repl.promote"} <= _SWEEP_FIRED
+            "repl.promote", "repl.partition", "repl.heal",
+            "storage.bitrot"} <= _SWEEP_FIRED

@@ -484,6 +484,143 @@ def test_torn_queue_offset_states_are_pinned(tmp_path):
     assert after == before + 1, "the degrade must be LOUD"
 
 
+# ----------------------------------------------------------------------
+# bit rot: per-record CRCs + the scrubber (docs/ROBUSTNESS.md
+# "Partition tolerance & degraded mode")
+
+
+def test_midfile_bit_flip_detected_and_read_repaired(tmp_path):
+    """A parseable record whose bytes changed at rest (crc mismatch)
+    is CORRUPTION, not a crash state: the load refuses loudly, and
+    the scrubber read-repairs it from a peer-supplied copy."""
+    from fluidframework_tpu.service.storage import (
+        CorruptRecordError,
+        scrub_jsonl,
+        scrub_repair_jsonl,
+    )
+
+    _, final = _drive_some_ops(tmp_path)
+    oplog = tmp_path / "torn-doc" / "ops.jsonl"
+    lines = oplog.read_text().splitlines(keepends=True)
+    pristine = json.loads(lines[1])
+    row = json.loads(lines[1])
+    row["contents"] = {"bitrot": True}  # stale _crc: mismatch
+    lines[1] = json.dumps(row) + "\n"
+    oplog.write_text("".join(lines))
+    # the load refuses: rot must never be silently served
+    with pytest.raises(CorruptRecordError, match="crc mismatch"):
+        _reload_text(tmp_path)
+    # detect-only scrub classifies it (and nothing else)
+    report = scrub_jsonl(str(oplog), "oplog")
+    assert report.corrupt == [1] and not report.torn_tail
+    # read-repair from a "peer" copy makes the log whole again
+    repaired = scrub_repair_jsonl(
+        str(oplog), "oplog",
+        lambda i, rows: dict(pristine) if i == 1 else None)
+    assert repaired.repaired == 1
+    _, text = _reload_text(tmp_path)
+    assert text == final
+
+
+def test_torn_tail_still_recovers_locally_not_via_scrub(tmp_path):
+    """The scrubber DISTINGUISHES: a torn tail is the PR9-recoverable
+    crash state — left byte-for-byte for the loader's local discard,
+    never treated as rot needing a peer."""
+    from fluidframework_tpu.service.storage import (
+        scrub_jsonl,
+        scrub_repair_jsonl,
+    )
+
+    _, final = _drive_some_ops(tmp_path)
+    oplog = tmp_path / "torn-doc" / "ops.jsonl"
+    lines = oplog.read_bytes().splitlines(keepends=True)
+    oplog.write_bytes(b"".join(lines[:-1])
+                      + lines[-1][: len(lines[-1]) // 2])
+    report = scrub_jsonl(str(oplog), "oplog")
+    assert report.torn_tail and report.corrupt == []
+    # a repair pass with NO peer must succeed: nothing to repair
+    repaired = scrub_repair_jsonl(str(oplog), "oplog",
+                                  lambda i, rows: None)
+    assert repaired.repaired == 0
+    # the loader's torn-tail discard still applies (PR9 path)
+    _, text = _reload_text(tmp_path)
+    assert text == final.replace("x4.", "", 1)
+
+
+def test_garbage_crc_with_no_surviving_peer_raises_loudly(tmp_path):
+    """Unrepairable rot (every copy gone) must detonate, not degrade:
+    serving a record whose bytes are provably wrong would be silent
+    corruption."""
+    from fluidframework_tpu.service.storage import (
+        CorruptRecordError,
+        scrub_repair_jsonl,
+    )
+
+    _drive_some_ops(tmp_path)
+    oplog = tmp_path / "torn-doc" / "ops.jsonl"
+    lines = oplog.read_text().splitlines(keepends=True)
+    row = json.loads(lines[2])
+    row["_crc"] = (row.get("_crc") or 0) + 1  # garbage checksum
+    lines[2] = json.dumps(row) + "\n"
+    oplog.write_text("".join(lines))
+    with pytest.raises(CorruptRecordError, match="no surviving peer"):
+        scrub_repair_jsonl(str(oplog), "oplog",
+                           lambda i, rows: None)
+
+
+def test_queue_record_crc_detected_and_scrubbed(tmp_path):
+    """The partitioned plane's half: a bit-flipped queue record is
+    refused on consume and read-repaired from a replica root by
+    ReplicatedFileOrderingQueue.scrub()."""
+    from fluidframework_tpu.service.partitioning import (
+        ReplicatedFileOrderingQueue,
+    )
+    from fluidframework_tpu.service.storage import CorruptRecordError
+
+    roots = [str(tmp_path / n) for n in ("lead", "f1", "f2")]
+    q = ReplicatedFileOrderingQueue(roots[0], 1, roots[1:])
+    for i in range(4):
+        q.produce(0, "d", {"v": i})
+    # flip a byte in one FOLLOWER root's record 1
+    log = tmp_path / "f1" / "partition-0.jsonl"
+    lines = log.read_text().splitlines(keepends=True)
+    row = json.loads(lines[1])
+    row["payload"] = {"v": 99}  # stale crc
+    lines[1] = json.dumps(row) + "\n"
+    log.write_text("".join(lines))
+    from fluidframework_tpu.service.partitioning import (
+        FileOrderingQueue,
+    )
+
+    broken = FileOrderingQueue(str(tmp_path / "f1"), 1)
+    with pytest.raises(CorruptRecordError, match="crc"):
+        list(broken.read(0, 0))
+    assert q.scrub() == 1
+    fixed = FileOrderingQueue(str(tmp_path / "f1"), 1)
+    assert [r.payload["v"] for r in fixed.read(0, 0)] == [0, 1, 2, 3]
+
+
+def test_legacy_rows_without_crc_still_load(tmp_path):
+    """The PR4/PR6 interop discipline: pre-existing logs whose rows
+    carry no _crc keep loading (nothing to verify), and the next
+    rewrite stamps them."""
+    from fluidframework_tpu.service.storage import (
+        read_jsonl_tolerant,
+    )
+
+    _, final = _drive_some_ops(tmp_path)
+    oplog = tmp_path / "torn-doc" / "ops.jsonl"
+    rows = [json.loads(ln) for ln in
+            oplog.read_text().splitlines()]
+    for r in rows:
+        r.pop("_crc", None)
+    oplog.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    loaded, torn = read_jsonl_tolerant(str(oplog), "oplog")
+    assert len(loaded) == len(rows) and not torn
+    _, text = _reload_text(tmp_path)
+    assert text == final
+
+
 def test_gap_over_truncated_log_raises_actionably(tmp_path):
     """A replica behind a summary-truncated log whose reconnect-time
     catch-up was EMPTY (no trailing ops yet) must fail with the loud

@@ -463,6 +463,97 @@ def test_retry_without_jitter_live_tree_is_clean():
     assert findings == [], [f.key for f in findings]
 
 
+def test_unbounded_blocking_wait_rule(tmp_path):
+    """qoscheck:unbounded-blocking-wait — a while loop in a service
+    path that SLEEPS while waiting for external progress must carry a
+    deadline (a comparison against a clock reading or a
+    deadline/timeout-named bound): the minority-side quorum barrier
+    that hung every submitter forever is the bug class. Clean shapes:
+    the deadline-bounded barrier, a bounded ``for`` retry, and
+    non-service paths."""
+    svc = tmp_path / "service"
+    svc.mkdir()
+    bad = svc / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "class Barrier:\n"
+        "    def replicate(self, acked, quorum):\n"
+        "        while acked < quorum:\n"                    # BAD
+        "            time.sleep(0.05)\n"
+        "            acked = self.poll()\n"
+        "    def wait_injectable(self, acked, quorum):\n"
+        "        while acked < quorum:\n"                    # BAD
+        "            self._sleep(0.05)\n"
+        "            acked = self.poll()\n"
+        "    def bounded(self, acked, quorum, clock):\n"
+        "        deadline = clock() + 0.5\n"
+        "        while acked < quorum:\n"                    # ok
+        "            if clock() >= deadline:\n"
+        "                raise RuntimeError('unavailable')\n"
+        "            self._sleep(0.05)\n"
+        "            acked = self.poll()\n"
+        "    def named_timeout(self, acked, quorum):\n"
+        "        while acked < quorum and "
+        "self.elapsed() < self.timeout_s:\n"                 # ok
+        "            self._sleep(0.05)\n"
+        "            acked = self.poll()\n"
+        "    def no_sleep(self, items):\n"
+        "        while items:\n"          # ok: not a wait, no sleep
+        "            items.pop()\n"
+        "    def justified(self, acked, quorum):\n"
+        "        while acked < quorum:  "
+        "# fluidlint: disable=unbounded-blocking-wait -- test\n"
+        "            time.sleep(0.05)\n"
+        "            acked = self.poll()\n"
+    )
+    findings = [f for f in core.run_analysis(
+        roots=[str(bad)], families=["qoscheck"])
+        if f.rule == "unbounded-blocking-wait"]
+    assert sorted(f.key for f in findings) == [
+        "bad.py:Barrier.replicate.blockwait",
+        "bad.py:Barrier.wait_injectable.blockwait",
+    ]
+
+    # non-service paths are out of scope (drivers poll sockets with
+    # their own lifecycle; the rule is about the serving plane)
+    other = tmp_path / "elsewhere.py"
+    other.write_text(
+        "import time\n"
+        "def spin(q):\n"
+        "    while not q:\n"
+        "        time.sleep(0.01)\n"
+    )
+    assert [f for f in core.run_analysis(
+        roots=[str(other)], families=["qoscheck"])
+        if f.rule == "unbounded-blocking-wait"] == []
+
+
+def test_unbounded_blocking_wait_live_tree_is_clean():
+    """The quorum barrier's wait is deadline-bounded (the netsplit
+    fix) and nothing else in the service plane blocks unboundedly —
+    and the rule actually SEES the barrier (non-vacuity: a sleeping
+    while loop exists in replication.py)."""
+    findings = [
+        f for f in core.run_analysis(families=["qoscheck"])
+        if f.rule == "unbounded-blocking-wait"
+    ]
+    assert findings == [], [f.key for f in findings]
+    import ast as _ast
+
+    repl = open("fluidframework_tpu/service/replication.py").read()
+    loops = [n for n in _ast.walk(_ast.parse(repl))
+             if isinstance(n, _ast.While)]
+    sleeping = [
+        loop for loop in loops
+        if any(isinstance(n, _ast.Call)
+               and getattr(n.func, "attr", "") == "_sleep"
+               for stmt in loop.body for n in _ast.walk(stmt))
+    ]
+    assert sleeping, (
+        "the quorum barrier's deadline wait vanished — the rule has "
+        "nothing left to pin")
+
+
 def test_qoscheck_family_is_in_the_gate():
     assert "qoscheck" in core.FAMILIES
 

@@ -25,6 +25,9 @@ from fluidframework_tpu.service.replication import (
     FencedWriteError,
     FollowerReplica,
     LeaseHeldError,
+    LeaseUnreachableError,
+    NetworkTopology,
+    QuorumUnavailableError,
     ReplicatedSequencerGroup,
     SequencerLease,
 )
@@ -432,6 +435,329 @@ def test_failover_refused_while_lease_live(tmp_path):
     with pytest.raises(LeaseHeldError):
         g.failover()
     c.close()
+
+
+# ----------------------------------------------------------------------
+# partition tolerance: the deadline-bounded quorum barrier, degraded
+# mode, membership lifecycle, rejoin, scrubbing
+
+
+def _net_group(tmp_path, **kw):
+    """Group on a manual clock with a NetworkTopology and a sleep
+    that ADVANCES the clock — the barrier's deadline wait terminates
+    deterministically instead of spinning forever."""
+    clock = _Clock()
+    net = NetworkTopology()
+    kw.setdefault("n_followers", 2)
+    kw.setdefault("quorum_timeout_s", 0.2)
+    kw.setdefault("retry_interval_s", 0.05)
+    g = ReplicatedSequencerGroup(
+        str(tmp_path), clock=clock, network=net,
+        sleep=lambda dt: setattr(clock, "t", clock.t + dt), **kw)
+    return g, clock, net
+
+
+def test_vanished_follower_set_cannot_hang_a_submitter(tmp_path):
+    """THE regression the deadline exists for: with every follower
+    across a partition, a submit must come back as a RETRIABLE
+    unavailable nack within the configured deadline on the manual
+    clock — never hang in the quorum wait — and the refused op must
+    be fully unwound (log, durable file, sequencer)."""
+    from fluidframework_tpu.qos.policy import REASON_UNAVAILABLE
+
+    g, clock, net = _net_group(tmp_path)
+    c = _load_writer(g)
+    c._backoff_clock = clock  # throttle backoff on the manual clock
+    final = _drive(c, 2)
+    orderer = g.server.get_orderer("doc")
+    head = orderer.op_log.last_seq
+    seq_before = orderer.sequencer.sequence_number
+    net.partition([["node-0"], ["node-1", "node-2"]])
+    t0 = clock.t
+    nacks = []
+    c.on("nack", nacks.append)
+    _text_channel(c).insert_text(0, "LOST.")
+    c.flush()  # must RETURN (nack), not hang
+    assert nacks, "the refused write must surface as a nack"
+    nack = nacks[0]
+    assert nack.retry_after_seconds and nack.retry_after_seconds > 0
+    assert nack.shed_class == REASON_UNAVAILABLE
+    # the discovery cost exactly one deadline on the injected clock
+    assert clock.t - t0 <= g.quorum_timeout_s + 0.01 + 0.3
+    assert g.degraded and g.metrics["degraded"].value == 1
+    # full unwind: nothing leaked into the log, the durable file or
+    # the sequencer — the op stays with its submitter
+    assert orderer.op_log.last_seq == head
+    assert orderer.sequencer.sequence_number == seq_before
+    rows = [json.loads(ln) for ln in open(os.path.join(
+        str(tmp_path), "node-0", "doc", "ops.jsonl"))]
+    assert rows[-1]["sequenceNumber"] == head
+    # later submits fast-nack off the CACHED verdict: no more
+    # deadline waits (probed at the orderer — the client itself is
+    # down and backing off, exactly as the nack told it to)
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+
+    t1 = clock.t
+    nack2 = orderer.submit("probe", DocumentMessage(
+        client_sequence_number=1,
+        reference_sequence_number=orderer.op_log.last_seq,
+        type=MessageType.OPERATION, contents={}))
+    assert nack2 is not None and \
+        nack2.shed_class == REASON_UNAVAILABLE
+    assert clock.t == t1, "a latched verdict must not pay the wait"
+    # a refused reconnect surfaces the retriable error to the driver
+    with pytest.raises(QuorumUnavailableError):
+        g.server.connect("doc", "z", on_message=lambda m: None)
+    # reads stay served, clamped at the committed watermark
+    assert [m.sequence_number for m in g.server.read_ops("doc", 0)][-1] \
+        == g.committed("doc")
+    # ALSO-LOST lands while the client is down: pending local state
+    _text_channel(c).insert_text(0, "ALSO-LOST.")
+    # heal: the next join probes, exits degraded, and the pending
+    # ops converge through the normal reconnect/resubmit path
+    net.heal()
+    clock.t += 2.0  # the nack backoff window passes
+    c.flush()  # reconnect-on-nack replays the pending edits
+    assert not g.degraded
+    r = _load_writer(g, client="r")
+    assert "LOST." in _text_channel(r).get_text()
+    assert "ALSO-LOST." in _text_channel(r).get_text()
+    assert _text_channel(r).get_text().endswith(final)
+    assert g.metrics["unavailable"].value >= 2
+    assert g.metrics["degraded_s"].value > 0
+    c.close()
+    r.close()
+
+
+def test_lease_isolation_browns_out_until_heal(tmp_path):
+    """The lease service in its own island: replication works but
+    leadership cannot be proven past the TTL — writes refuse with
+    the retriable nack (read-only brownout), and the first renewal
+    after the heal resumes acks with no election."""
+    g, clock, net = _net_group(tmp_path, lease_ttl=0.3)
+    c = _load_writer(g)
+    c._backoff_clock = clock
+    _drive(c, 2)
+    epoch = g.epoch
+    net.partition([["node-0", "node-1", "node-2"], []],
+                  lease_island=1)
+    clock.t += 0.4  # TTL lapses; renewals are lost across the split
+    assert g.lease.expired()
+    nacks = []
+    c.on("nack", nacks.append)
+    _text_channel(c).insert_text(0, "B.")
+    c.flush()
+    assert nacks and g.degraded
+    assert g.degraded_reason == "lease_unreachable"
+    # elections are impossible from an isolated island
+    with pytest.raises(LeaseUnreachableError):
+        g.lease.acquire("node-1")
+    net.heal()
+    clock.t += 1.0  # the nack backoff window passes
+    c.flush()
+    assert not g.degraded
+    assert g.epoch == epoch, "no election: same leader, same epoch"
+    r = _load_writer(g, client="r")
+    assert "B." in _text_channel(r).get_text()
+    c.close()
+    r.close()
+
+
+def test_membership_shrinks_on_grace_and_grows_on_rejoin(tmp_path):
+    """A follower unseen past the grace TTL detaches (quorum
+    recomputes over the remaining set); rejoin() re-admits it behind
+    the epoch fence with a bit-equal replicated head."""
+    g, clock, net = _net_group(tmp_path, membership_grace_s=0.3)
+    c = _load_writer(g)
+    _drive(c, 2)
+    net.partition([["node-0", "node-1"], ["node-2"]])
+    for i in range(8):
+        clock.t += 0.1
+        _text_channel(c).insert_text(0, f"g{i}.")
+        c.flush()
+    assert [f.node_id for f in g.followers] == ["node-1"]
+    assert "node-2" in g.detached
+    assert g.quorum == 2
+    head = g.server.get_orderer("doc").op_log.last_seq
+    net.heal()
+    f = g.rejoin("node-2")
+    assert [x.node_id for x in g.followers] == ["node-1", "node-2"]
+    assert g.quorum == 2
+    assert f.head("doc") == g.committed("doc"), (
+        "rejoin must land on the committed replicated head")
+    assert f.max_epoch_seen == g.fence.epoch
+    assert g.metrics["rejoins"].value == 1
+    # and the rejoined follower partakes in the next quorum
+    _text_channel(c).insert_text(0, "post.")
+    c.flush()
+    assert f.head("doc") == head + 1 or f.head("doc") == \
+        g.server.get_orderer("doc").op_log.last_seq
+    c.close()
+
+
+def test_wiped_follower_rejoins_bit_equal_from_peer(tmp_path):
+    """A crashed-AND-wiped follower (dir deleted) resyncs its whole
+    history from a surviving full-history peer — byte-equal records,
+    fresh crcs, exact head."""
+    import shutil
+
+    g, clock, net = _net_group(tmp_path)
+    c = _load_writer(g)
+    _drive(c, 4)
+    victim = g.followers[1]
+    victim._heads.clear()
+    victim._lag.clear()
+    root = g.detach(victim.node_id, origin="wipe")
+    shutil.rmtree(root)
+    assert g.quorum == 2
+    f = g.rejoin("node-2")
+    peer = g.followers[0]
+    assert f.head("doc") == peer.head("doc") > 0
+    assert [m.sequence_number for m in f.read_log("doc")] == \
+        [m.sequence_number for m in peer.read_log("doc")]
+    # bit-equal replicated head: same records, verified crcs
+    rows_f = [json.loads(ln) for ln in open(
+        os.path.join(f.root, "doc", "ops.jsonl"))]
+    rows_p = [json.loads(ln) for ln in open(
+        os.path.join(peer.root, "doc", "ops.jsonl"))]
+    strip = lambda r: {k: v for k, v in r.items()  # noqa: E731
+                       if k not in ("_crc", "traces")}
+    assert [strip(r) for r in rows_f] == [strip(r) for r in rows_p]
+    c.close()
+
+
+def test_scrub_read_repairs_bit_flip_from_peer(tmp_path):
+    """A mid-file bit flip on one follower's log (parseable JSON,
+    wrong crc) is detected and read-repaired from a quorum peer,
+    loudly counted; with NO surviving intact copy it raises."""
+    from fluidframework_tpu.obs import metrics as om
+    from fluidframework_tpu.service.storage import CorruptRecordError
+
+    g, clock, net = _net_group(tmp_path)
+    c = _load_writer(g)
+    final = _drive(c, 4)
+    c.close()
+    target = g.followers[0]
+    path = os.path.join(target.root, "doc", "ops.jsonl")
+    lines = open(path).readlines()
+    row = json.loads(lines[1])
+    row["contents"] = {"rot": True}  # stale _crc kept: crc mismatch
+    lines[1] = json.dumps(row) + "\n"
+    fh = target._fhs.pop("doc", None)
+    if fh is not None:
+        fh.close()
+    open(path, "w").writelines(lines)
+    before = om.REGISTRY.flat().get(
+        'storage_scrub_repairs_total{file="repl"}', 0)
+    assert g.scrub() == 1
+    assert om.REGISTRY.flat()[
+        'storage_scrub_repairs_total{file="repl"}'] == before + 1
+    # the repaired replica is whole again: a fresh load serves it
+    target.close()
+    f2 = FollowerReplica(target.root, target.node_id)
+    assert [m.sequence_number for m in f2.read_log("doc")] == \
+        list(range(1, f2.head("doc") + 1))
+    f2.close()
+    # no surviving peer: corrupt the SAME record everywhere
+    for node in [g.followers[1]]:
+        p2 = os.path.join(node.node_id and node.root, "doc",
+                          "ops.jsonl")
+        lns = open(p2).readlines()
+        r2 = json.loads(lns[1])
+        r2["contents"] = {"rot": 2}
+        lns[1] = json.dumps(r2) + "\n"
+        fh = node._fhs.pop("doc", None)
+        if fh is not None:
+            fh.close()
+        open(p2, "w").writelines(lns)
+    # and truncate the leader's log above the record so it cannot
+    # supply the copy either
+    g.server.get_orderer("doc").op_log.truncate_below(99)
+    # re-corrupt the first follower too
+    lines = open(path).readlines()
+    row = json.loads(lines[1])
+    row["contents"] = {"rot": 3}
+    lines[1] = json.dumps(row) + "\n"
+    g.followers[0].close()
+    open(path, "w").writelines(lines)
+    with pytest.raises(CorruptRecordError, match="no surviving peer"):
+        g.scrub()
+    assert final  # silence the unused warning
+
+
+def test_degraded_reprobe_is_paced_without_a_topology(tmp_path):
+    """Production has NO NetworkTopology (reachability is only
+    discoverable by trying): after a quorum timeout, later writes
+    must fast-nack off the cached verdict, with exactly ONE paced
+    probe write per timeout window allowed through to the barrier —
+    whose quorum success is what exits degraded."""
+    clock = _Clock()
+    g = ReplicatedSequencerGroup(
+        str(tmp_path), clock=clock, n_followers=2,
+        quorum_timeout_s=0.2, retry_interval_s=0.05,
+        sleep=lambda dt: setattr(clock, "t", clock.t + dt))
+    c = _load_writer(g)
+    _drive(c, 2)
+    # the barrier timed out somewhere (simulated entry: in-process
+    # followers cannot actually vanish without a topology)
+    g._enter_degraded("quorum_timeout")
+    with pytest.raises(QuorumUnavailableError):
+        g.ensure_available("doc")  # inside the window: fast-nack
+    clock.t += 0.25  # the probe window opens
+    g.ensure_available("doc")  # the ONE paced probe passes the gate
+    with pytest.raises(QuorumUnavailableError):
+        g.ensure_available("doc")  # next window not open yet
+    # the probe write runs the barrier; quorum success exits degraded
+    clock.t += 0.25
+    _text_channel(c).insert_text(0, "probe.")
+    c.flush()
+    assert not g.degraded
+    assert g.metrics["degraded_s"].value > 0
+    c.close()
+
+
+def test_owed_leave_resets_csn_watermark_on_rejoin(tmp_path):
+    """A leave absorbed during the degraded window is OWED: the
+    client's next join sequences it first, so the fresh-csn resubmit
+    stream is never swallowed by the duplicate dedupe (the netsplit
+    differential's silent-divergence bug, pinned in isolation)."""
+    g, clock, net = _net_group(tmp_path)
+    msgs = []
+    conn = g.server.connect("doc", "w", on_message=msgs.append)
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+
+    orderer = g.server.get_orderer("doc")
+
+    def op(csn):
+        return DocumentMessage(
+            client_sequence_number=csn,
+            reference_sequence_number=orderer.op_log.last_seq,
+            type=MessageType.OPERATION, contents={"v": csn})
+
+    assert conn._orderer.submit("w", op(1)) is None
+    assert conn._orderer.submit("w", op(2)) is None
+    net.partition([["node-0"], ["node-1", "node-2"]])
+    # the leave cannot replicate: absorbed + owed
+    conn.disconnect()
+    assert "w" in orderer._owed_leaves
+    net.heal()
+    # rejoin settles the owed leave FIRST (watermark reset), so the
+    # fresh stream's csn 1 sequences instead of deduping silently
+    conn2 = g.server.connect("doc", "w", on_message=msgs.append)
+    assert "w" not in orderer._owed_leaves
+    assert conn2._orderer.submit("w", op(1)) is None
+    ops = [m for m in orderer.op_log.read(0)
+           if m.type == MessageType.OPERATION]
+    assert [m.client_sequence_number for m in ops] == [1, 2, 1], (
+        "the post-rejoin csn-1 op must SEQUENCE, not silently dedupe")
+    kinds = [m.type for m in orderer.op_log.read(0)]
+    assert kinds.count(MessageType.CLIENT_LEAVE) == 1
 
 
 # ----------------------------------------------------------------------
