@@ -42,7 +42,7 @@ import time
 
 STAGES = ("probe", "fuzz", "config1", "config2", "config3", "config4",
           "config5", "config6", "config7", "config8", "config9",
-          "config10", "config11", "config12", "config13")
+          "config10", "config11", "config12", "config13", "config14")
 
 # Machine-readable corpus identity, stamped into EVERY stage record
 # (r5 silently changed the stream mix — flow-mix quarter joined — and
@@ -67,6 +67,7 @@ STAGE_CORPUS = {
     "config11": {"generator": "chaos-standard", "version": 1},
     "config12": {"generator": "chaos-failover", "version": 1},
     "config13": {"generator": "chaos-netsplit", "version": 1},
+    "config14": {"generator": "route-tri-corpus", "version": 1},
 }
 
 
@@ -2366,6 +2367,221 @@ def stage_config13(scale: str, reps: int, cooldown: float) -> dict:
     }
 
 
+def stage_config14(scale: str, reps: int, cooldown: float) -> dict:
+    """Per-route single-chip executor comparison (ROADMAP item 1 /
+    the egwalker PR): the REAL TpuMergeSidecar serving loop driven
+    through all THREE executor routes — scan, chunked, egwalker — on
+    three corpora chosen by their event-graph structure:
+
+      sequential-heavy  fully-sequential multi-client editing (every
+                        op critical: the walker's fast path and most
+                        real traffic — testing.record_sequential_stream)
+      concurrent-heavy  blind multi-client typing (process_weight
+                        0.05: almost every op concurrent — the walker
+                        degenerates to its scan suffix)
+      mixed             the standard bench fuzz mix (process 0.15)
+
+    plus the scalar-Python and C++ -O2 proxy baselines on the same
+    streams. Per corpus the record carries per-route ops/s, the
+    event-graph sequentiality stats (critical fraction, walker spans
+    per window vs chunked chunks — the kernel-launch count a
+    launch-taxed backend pays), and parity is text-verified against
+    the scalar oracle for every route. ALSO the current standing for
+    the r3/r5 "1.18M ops/s ≈ 0.18x C++" single-chip number, which
+    predates the pipelined dispatch and this route.
+
+    ACCEPTANCE (CPU): the egwalker route must beat the chunked
+    route's ops/s on the sequential-heavy corpus at equal batch —
+    asserted below, not just recorded."""
+    import numpy as np
+
+    from fluidframework_tpu.models.mergetree import MergeTreeClient
+    from fluidframework_tpu.ops import encode_stream
+    from fluidframework_tpu.ops.event_graph import build_event_graph
+    from fluidframework_tpu.ops.merge_chunk import (
+        CHUNK_K,
+        build_chunked,
+    )
+    from fluidframework_tpu.ops.host_bridge import OP_FIELDS
+    from fluidframework_tpu.ops.segment_table import OpBatch
+    from fluidframework_tpu.protocol.messages import MessageType
+    from fluidframework_tpu.service.tpu_sidecar import (
+        TpuMergeSidecar,
+        default_executor,
+    )
+    from fluidframework_tpu.testing import (
+        FuzzConfig,
+        record_op_stream,
+        record_sequential_stream,
+    )
+
+    docs, base, steps, clients, capacity, round_ops = {
+        "full": (1024, 16, 120, 3, 512, 8),
+        "cpu": (192, 8, 60, 3, 256, 8),
+        "smoke": (32, 4, 30, 2, 128, 8),
+    }[scale]
+
+    def corpus_streams(kind: str):
+        raw, encs = [], []
+        for i in range(base):
+            if kind == "sequential":
+                _, stream = record_sequential_stream(
+                    seed=14000 + i, n_clients=clients, n_steps=steps)
+            elif kind == "concurrent":
+                _, stream = record_op_stream(FuzzConfig(
+                    n_clients=max(clients, 4), n_steps=steps,
+                    seed=14100 + i, insert_weight=0.55,
+                    remove_weight=0.25, annotate_weight=0.05,
+                    process_weight=0.05,
+                ))
+            else:
+                _, stream = record_op_stream(FuzzConfig(
+                    n_clients=clients, n_steps=steps, seed=14200 + i,
+                    insert_weight=0.55, remove_weight=0.25,
+                    annotate_weight=0.05, process_weight=0.15,
+                ))
+            raw.append(stream)
+            encs.append(encode_stream(stream))
+        return raw, encs
+
+    n_reps = max(2, reps // 2)
+
+    def best_of(fn):
+        best_w = None
+        keep = None
+        for _ in range(n_reps):
+            time.sleep(min(cooldown, 2.0))
+            out = fn()
+            if best_w is None or out[2] < best_w:
+                best_w, keep = out[2], out
+        return keep
+
+    def run(encs, executor):
+        """config7's round-based serving drive, one route."""
+        rounds = (max(len(e.ops) for e in encs) + round_ops - 1) \
+            // round_ops
+        sidecar = TpuMergeSidecar(
+            max_docs=docs, capacity=capacity,
+            max_capacity=capacity * 4, executor=executor,
+        )
+        for d in range(docs):
+            slot = sidecar.track(f"doc-{d}", "d", "s")
+            sidecar._streams[slot] = encs[d % base]
+        total = 0
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            lo, hi = r * round_ops, (r + 1) * round_ops
+            for d in range(docs):
+                sl = encs[d % base].ops[lo:hi]
+                if sl:
+                    sidecar._queued[d].extend(sl)
+            total += sidecar.apply()
+        sidecar.sync()
+        np.asarray(sidecar._table.count)  # transfer-forced
+        return sidecar, total, time.perf_counter() - t0
+
+    def graph_stats(encs):
+        """Event-graph structure of the corpus at full-window width:
+        critical fraction + walker spans vs chunked chunks per doc
+        (the per-window kernel-launch counts)."""
+        from fluidframework_tpu.ops.host_bridge import (
+            coalesce_noops as _cn,
+        )
+
+        packed = [_cn(e.ops) for e in encs]
+        W = max(len(p) for p in packed)
+        arrays = {f: np.zeros((base, W), np.int32) for f in OP_FIELDS}
+        arrays["kind"][:] = 3  # KIND_NOOP
+        for d, ops in enumerate(packed):
+            for f in OP_FIELDS:
+                arrays[f][d, :len(ops)] = np.fromiter(
+                    (op[f] for op in ops), np.int32, len(ops))
+        program = build_event_graph(arrays)
+        g = program["graph"]
+        real = arrays["kind"] != 3
+        crit = float((g.critical.astype(bool) & real).sum()
+                     / max(real.sum(), 1))
+        spans = (float(
+            program["prefix"]["chunk_start"].sum() / base)
+            if program["prefix"] is not None else 0.0)
+        chunked = build_chunked(OpBatch(**arrays), K=CHUNK_K)
+        chunks = float(chunked["chunk_start"].sum() / base)
+        return {
+            "critical_fraction": round(crit, 4),
+            "walker_spans_per_doc": round(spans, 1),
+            "chunked_chunks_per_doc": round(chunks, 1),
+            "docs_with_concurrent_suffix": int(
+                (g.prefix_len < np.int32(W)).sum()),
+        }
+
+    routes = ("scan", "chunked", "egwalker")
+    record: dict = {
+        "docs": docs,
+        "streams": base,
+        "round_ops": round_ops,
+        "capacity": capacity,
+        "executor_route": default_executor(),
+        "corpora": {},
+    }
+    kernel_best = 0.0
+    for kind in ("sequential", "concurrent", "mixed"):
+        raw, encs = corpus_streams(kind)
+        per_route = {}
+        sidecars = {}
+        for route in routes:
+            run(encs, route)  # compile pass
+            sc, total, wall = best_of(lambda r=route: run(encs, r))
+            sidecars[route] = sc
+            per_route[route] = {
+                "ops_per_sec": round(total / wall, 1),
+                "real_ops": total,
+                "best_wall_s": round(wall, 3),
+            }
+        # parity: every route serves the scalar oracle's text
+        for d in range(min(4, base)):
+            obs = MergeTreeClient("oracle")
+            obs.start_collaboration("oracle")
+            for msg in raw[d % base]:
+                if msg.type == MessageType.OPERATION:
+                    obs.apply_msg(msg)
+            want = obs.get_text()
+            for route in routes:
+                got = sidecars[route].text(f"doc-{d}", "d", "s")
+                assert got == want, (
+                    f"config14 {kind}/{route} oracle divergence "
+                    f"doc {d}")
+        py_ops_s = _py_baseline(raw, seconds=1.0)
+        cpp_ops_s, _ = _cpp_baseline(encs)
+        record["corpora"][kind] = {
+            "routes": per_route,
+            "graph": graph_stats(encs),
+            "python_baseline_ops_per_sec": round(py_ops_s, 1),
+            "cpp_baseline_ops_per_sec": (
+                round(cpp_ops_s, 1) if cpp_ops_s else None),
+            "parity": f"text-verified x{min(4, base)} x3 routes",
+        }
+        kernel_best = max(
+            kernel_best,
+            max(r["ops_per_sec"] for r in per_route.values()))
+
+    record["kernel_ops_per_sec"] = round(kernel_best, 1)
+    seq = record["corpora"]["sequential"]["routes"]
+    record["egwalker_vs_chunked_sequential"] = round(
+        seq["egwalker"]["ops_per_sec"] / seq["chunked"]["ops_per_sec"],
+        2)
+    record["egwalker_vs_scan_sequential"] = round(
+        seq["egwalker"]["ops_per_sec"] / seq["scan"]["ops_per_sec"], 2)
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # the PR's acceptance criterion, enforced per run
+        assert seq["egwalker"]["ops_per_sec"] > \
+            seq["chunked"]["ops_per_sec"], (
+                "config14: the egwalker route must beat chunked on "
+                f"the sequential-heavy corpus on CPU, got {seq}")
+    return record
+
+
 STAGE_FNS = {
     "probe": stage_probe,
     "fuzz": stage_fuzz,
@@ -2382,6 +2598,7 @@ STAGE_FNS = {
     "config11": stage_config11,
     "config12": stage_config12,
     "config13": stage_config13,
+    "config14": stage_config14,
 }
 
 

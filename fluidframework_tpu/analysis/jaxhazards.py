@@ -82,6 +82,17 @@ DISPATCH_LOOPS = {
          "_apply_program"),
         ("_settle", "sync"),
     ),
+    # The egwalker route's dispatch path (ops/event_graph.py): the
+    # host graph/span compiler runs in the pipeline's pack stage and
+    # the walker dispatch wrappers run in its device stage — a
+    # device->host read in either re-serializes the pipeline exactly
+    # like one in the sidecar module itself (the sidecar's
+    # _compile_program/_apply_program call straight into these).
+    "ops/event_graph.py": (
+        ("build_event_graph", "apply_window_egwalker",
+         "apply_window_egwalker_pingpong", "apply_batch_egwalker"),
+        (),
+    ),
     # The obs instrumentation the dispatch loop calls into (flight-
     # recorder records, metric bumps, trace stamps) must itself stay
     # sync-free: host timestamps and pre-fetched scalars only. Rooting

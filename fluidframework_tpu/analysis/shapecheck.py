@@ -64,6 +64,9 @@ LADDER_SOURCES = (
     ("service/tpu_sidecar.py", "_pack_rows"),
     ("ops/merge_chunk.py", "compile_chunks"),
     ("ops/merge_chunk.py", "build_chunked"),
+    # the event-graph compiler re-buckets its prefix/suffix windows
+    # through the BucketLadder internally (same contract as pack_rows)
+    ("ops/event_graph.py", "build_event_graph"),
     ("ops/segment_table.py", "make_table"),
 )
 
@@ -90,6 +93,15 @@ LADDERED_CALLS: dict[tuple[str, str, str], str] = {
      "apply_window_chunked[K]"):
         "K=CHUNK_K module constant (single-shard chunked fast path); "
         "MeshShardedPool.prewarm walks it",
+    # EG_K is the egwalker factory's static program-selection
+    # constant, exactly like CHUNK_K for the chunked route: one
+    # program per route, prewarm dispatches through the same K.
+    ("tpu_sidecar.py", "TpuMergeSidecar._apply_program",
+     "apply_window_egwalker[K]"):
+        "K=EG_K module constant; prewarm walks the egwalker route",
+    ("tpu_sidecar.py", "TpuMergeSidecar._apply_program",
+     "apply_window_egwalker_pingpong[K]"):
+        "K=EG_K module constant; prewarm walks the ping-pong jits",
 }
 
 # Calls whose result is freshly allocated (never aliases argument
@@ -1515,6 +1527,8 @@ def ladder_bounds(window_floor: int, max_bucket: int,
         "apply_window_pingpong": shapes if donate else 0,
         "chunked": shapes,
         "chunked_pingpong": shapes if donate else 0,
+        "egwalker": shapes,
+        "egwalker_pingpong": shapes if donate else 0,
         # one per capacity rung
         "compact": n_rungs,
         # one per rung TRANSITION
@@ -1524,9 +1538,21 @@ def ladder_bounds(window_floor: int, max_bucket: int,
     if executor == "scan":
         bounds["chunked"] = 0
         bounds["chunked_pingpong"] = 0
+        bounds["egwalker"] = 0
+        bounds["egwalker_pingpong"] = 0
+    elif executor == "egwalker":
+        # the walker covers critical prefixes; concurrent SUFFIXES
+        # dispatch the PLAIN scan jit per rung x bucket (never the
+        # ping-pong form — the suffix input is the walker stage's
+        # live output), and prewarm walks both programs
+        bounds["chunked"] = 0
+        bounds["chunked_pingpong"] = 0
+        bounds["apply_window_pingpong"] = 0
     else:
         bounds["apply_window"] = 0
         bounds["apply_window_pingpong"] = 0
+        bounds["egwalker"] = 0
+        bounds["egwalker_pingpong"] = 0
     if pool_capacity is not None:
         # MeshShardedPool jit roots (per-shard ladder x sharding
         # signatures): ``pool_rows`` is the largest per-shard row
@@ -1544,6 +1570,19 @@ def ladder_bounds(window_floor: int, max_bucket: int,
         if not (window_floor <= chunk <= max_bucket):
             n_windows += 1
         bounds["mesh_pool"] = rb * n_windows * 2
+        if executor in ("chunked", "egwalker"):
+            # BOTH pool tiers route these executors through the
+            # CHUNKED kernel on a degenerate mesh (the seq pool's
+            # n_seq==1 fast path, the mesh pool's single-shard fast
+            # path; an egwalker pool deliberately routes chunked —
+            # pool dispatches are full-history replays): those
+            # programs ride the shared merge_chunk jit cache at the
+            # pool's own (row bucket x window/replay-chunk x sharding
+            # signature) shapes, ON TOP of whatever the primary route
+            # compiles there — without this allowance a correctly
+            # laddered pooled egwalker sidecar would read as a
+            # recompile storm (bounds['chunked'] == 0)
+            bounds["chunked"] += rb * n_windows * 2
         # one gather program per pool table shape (x2 sharding sigs).
         # The migration handoff ALWAYS donates on backends that
         # support it (shard_moves.migrate_rows routes on the backend,
@@ -1574,7 +1613,8 @@ def infer_kernel_output(root: str, spec: dict,
     BY NAME."""
     identity_roots = {
         "apply_window", "apply_window_pingpong", "chunked",
-        "chunked_pingpong", "compact", "seq_shard", "pallas",
+        "chunked_pingpong", "egwalker", "egwalker_pingpong",
+        "compact", "seq_shard", "pallas",
     }
     if root in identity_roots:
         return {f: (tuple(shape), dtype)

@@ -11,6 +11,15 @@ from .host_bridge import (
     extract_text,
     fetch,
 )
+from .event_graph import (
+    EG_K,
+    EXECUTOR_ROUTES,
+    EventGraph,
+    apply_batch_egwalker,
+    apply_window_egwalker,
+    build_event_graph,
+    validate_executor,
+)
 from .merge_kernel import apply_window, compact
 from .segment_table import (
     KIND_ANNOTATE,
@@ -27,9 +36,16 @@ from .segment_table import (
 
 __all__ = [
     "DocStream",
+    "EG_K",
+    "EXECUTOR_ROUTES",
+    "EventGraph",
     "OpBatch",
     "SegmentTable",
+    "apply_batch_egwalker",
     "apply_window",
+    "apply_window_egwalker",
+    "build_event_graph",
+    "validate_executor",
     "build_batch",
     "compact",
     "encode_stream",

@@ -60,6 +60,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..obs import metrics as obs_metrics
 from ..obs.trace import stamp as _trace_stamp
 from ..ops.bucket_ladder import BucketLadder
+from ..ops.event_graph import validate_executor
 from ..ops.host_bridge import coalesce_noops, pack_rows, replay_chunked
 from ..ops.merge_chunk import (
     CHUNK_K,
@@ -226,14 +227,18 @@ class MeshShardedPool:
         self.doc_axis = doc_axis
         self.n_shards = mesh.shape[doc_axis]
         self.capacity = per_doc_capacity
-        # the chunked macro-step does not ride the doc-sharded
+        # the chunked/egwalker macro-steps do not ride the doc-sharded
         # shard_map dispatch (yet); a single-shard mesh follows the
-        # executor route exactly like the degenerate seq pool, a
-        # multi-shard mesh uses the scan window body and says so
-        # LOUDLY once (counter + stderr, _warn_route_once). The
-        # backend-default route lives in service (default_executor);
-        # select_pool resolves it before constructing this pool —
-        # None here (direct construction) just means scan
+        # executor route exactly like the degenerate seq pool (an
+        # egwalker pool routes CHUNKED there: pool dispatches are
+        # full-history replays, where the critical-prefix fast path
+        # buys nothing by construction), a multi-shard mesh uses the
+        # scan window body and says so LOUDLY once (counter + stderr,
+        # _warn_route_once). The backend-default route lives in
+        # service (default_executor); select_pool resolves it before
+        # constructing this pool — None here (direct construction)
+        # just means scan
+        validate_executor(executor, "executor")
         self.executor = executor or "scan"
         self._route_warned = False
         # per-shard ownership: shard_members[s][r] = sidecar slot at
@@ -305,19 +310,20 @@ class MeshShardedPool:
         self._route_warned = True
         _M_ROUTE_FALLBACK.inc()
         print(
-            "fftpu: MeshShardedPool: the chunked macro-step does not "
-            "ride the doc-sharded shard_map dispatch; using the scan "
-            f"window body on this {self.n_shards}-shard mesh",
+            f"fftpu: MeshShardedPool: the {self.executor} macro-step "
+            "does not ride the doc-sharded shard_map dispatch; using "
+            f"the scan window body on this {self.n_shards}-shard mesh",
             file=sys.stderr, flush=True,
         )
 
     def _apply(self, table, arrays):
-        if self.executor == "chunked" and self.n_shards == 1:
+        if self.executor in ("chunked", "egwalker") and \
+                self.n_shards == 1:
             out = apply_window_chunked(
                 table, compile_chunks(arrays, k_max=CHUNK_K), K=CHUNK_K
             )
         else:
-            if self.executor == "chunked":
+            if self.executor in ("chunked", "egwalker"):
                 self._warn_route_once()
             out = apply_window_mesh_sharded(
                 table, OpBatch(**arrays), self.mesh, self.doc_axis

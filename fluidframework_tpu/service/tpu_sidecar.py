@@ -66,6 +66,14 @@ from ..ops import (
     make_table,
 )
 from ..ops.bucket_ladder import BucketLadder
+from ..ops.event_graph import (
+    EG_K,
+    EXECUTOR_ROUTES,
+    apply_window_egwalker,
+    apply_window_egwalker_pingpong,
+    build_event_graph,
+    validate_executor,
+)
 from ..ops.host_bridge import (
     OP_FIELDS,
     pack_rows as _pack_rows,
@@ -162,18 +170,21 @@ def default_executor() -> str:
     macro-step's [D, C+3K, K] resolve + sort costs 4-5x a fused scan
     step there and launches are ~free, so routing chunked would be a
     measured serving REGRESSION (bench config7 records both routes
-    per backend). ``FFTPU_SIDECAR_EXECUTOR=chunked|scan`` overrides
-    either way (the operational escape hatch)."""
+    per backend). The THIRD route, ``egwalker`` (ops/event_graph.py:
+    critical-version fast path over the concurrent-op event graph),
+    is explicitly routed for now — bench config14 records where it
+    wins per corpus (4-6x over chunked on sequential-heavy CPU
+    traffic; ~4x fewer kernel launches per window than either route,
+    the number that matters on the launch-taxed tunnel) — and
+    becomes a backend default only once real-chip numbers land.
+    ``FFTPU_SIDECAR_EXECUTOR=scan|chunked|egwalker`` overrides either
+    way (the operational escape hatch)."""
     env = os.environ.get("FFTPU_SIDECAR_EXECUTOR")
     if env:
-        if env not in ("scan", "chunked"):
-            # the escape hatch must fail LOUDLY on a typo: silently
-            # falling back to the backend default would mean an
-            # emergency route change that never happened
-            raise ValueError(
-                f"FFTPU_SIDECAR_EXECUTOR={env!r}: expected 'scan' "
-                "or 'chunked'"
-            )
+        # the escape hatch must fail LOUDLY on a typo: silently
+        # falling back to the backend default would mean an
+        # emergency route change that never happened
+        validate_executor(env, "FFTPU_SIDECAR_EXECUTOR")
         return env
     import jax
 
@@ -225,12 +236,18 @@ class SeqShardedPool:
         self.mesh = mesh
         self.n_seq = n_seq
         self.capacity = per_doc_capacity
-        # the chunked macro-step's global multi-key sort does not
-        # decompose over a slot-sharded axis, so the chunked route
-        # applies only on a degenerate (n_seq == 1) mesh; a real seq
+        # the chunked/egwalker macro-steps' global multi-key sort does
+        # not decompose over a slot-sharded axis, so those routes
+        # apply only on a degenerate (n_seq == 1) mesh; a real seq
         # mesh keeps the scan-collective executor (docs/PERF.md) and
         # SAYS SO once (counter + stderr, _warn_route_once) — the
-        # silent off-route fallback used to be invisible
+        # silent off-route fallback used to be invisible. On the
+        # degenerate mesh an egwalker pool routes CHUNKED: the pool
+        # only ever replays full histories (admission/rebuild), where
+        # the critical-prefix fast path buys nothing by construction
+        # (replay chunks carry arbitrary concurrency) and chunked owns
+        # the launch-amortized replay recipe.
+        validate_executor(executor, "executor")
         self.executor = executor or default_executor()
         self._route_warned = False
         self.members: list[int] = []      # sidecar slot per pool row
@@ -264,8 +281,8 @@ class SeqShardedPool:
         import sys
 
         print(
-            "fftpu: SeqShardedPool: the chunked macro-step does not "
-            "decompose over a slot-sharded axis; using the "
+            f"fftpu: SeqShardedPool: the {self.executor} macro-step "
+            "does not decompose over a slot-sharded axis; using the "
             f"scan-collective route on this {self.n_seq}-way seq mesh "
             "(a docs-sharded MeshShardedPool follows the executor "
             "route — see select_pool)",
@@ -275,12 +292,15 @@ class SeqShardedPool:
     def _apply(self, table, arrays):
         from ..parallel import apply_window_seq_sharded
 
-        if self.executor == "chunked" and self.n_seq == 1:
+        if self.executor in ("chunked", "egwalker") and self.n_seq == 1:
+            # egwalker routes chunked here on purpose: pool dispatches
+            # are full-history replays, chunked's home turf (see the
+            # executor-route comment in __init__)
             out = apply_window_chunked(
                 table, compile_chunks(arrays, k_max=CHUNK_K), K=CHUNK_K
             )
         else:
-            if self.executor == "chunked":
+            if self.executor in ("chunked", "egwalker"):
                 self._warn_route_once()
             out = apply_window_seq_sharded(
                 table, OpBatch(**arrays), self.mesh
@@ -450,6 +470,7 @@ def select_pool(mesh, per_doc_capacity: Optional[int] = None,
     of slot sharding); the mesh pool grants 4x the ladder top (its
     capacity unlock is MEMBER COUNT — per-doc stays chip-local)."""
     source = "pool_route"
+    validate_executor(executor, "executor")
     if route is None:
         route = os.environ.get("FFTPU_SIDECAR_POOL") or None
         source = "FFTPU_SIDECAR_POOL"
@@ -557,7 +578,12 @@ class TpuMergeSidecar:
                     reason=f"circuit breaker {b.name!r} opened "
                            f"(last error: {b.last_error!r})")
             breaker.on_open = _dump_on_open
-        # dispatch-route knobs (env-overridable escape hatches)
+        # dispatch-route knobs (env-overridable escape hatches). The
+        # CONSTRUCTOR-ARG spelling of a route typo must be exactly as
+        # loud as the env one (the select_pool discipline): an
+        # executor='egwalkr' silently serving the backend default is
+        # an emergency route change that never happened.
+        validate_executor(executor, "executor")
         self.executor = executor or default_executor()
         if pipeline is not None:
             self.pipeline = pipeline
@@ -613,6 +639,14 @@ class TpuMergeSidecar:
         # per-document last ingested seq (the at-least-once dedupe
         # guard in ingest)
         self._last_ingested: dict[str, int] = {}
+        # per-slot APPLIED-HEAD seq watermark (egwalker route): the
+        # max sequence number of any op already dispatched for the
+        # slot. build_event_graph judges the criticality of ops whose
+        # refseq predates the window against it — conservative (a
+        # stale-low head demotes ops to the exact scan suffix, never
+        # the reverse), updated at each dispatch AFTER the window's
+        # program is compiled against the pre-window value.
+        self._slot_head = np.zeros(max_docs, np.int64)
         # the encoded stream is the single canonical per-doc history:
         # grow re-replays it on device, eviction decodes it back into
         # sequenced messages for the scalar replica (no duplicate raw
@@ -832,6 +866,14 @@ class TpuMergeSidecar:
                 dead = (make_table(self.max_docs, rung)
                         if self.donate else None)
                 table = self._apply_program(table, program, dead)
+                if self.executor == "egwalker":
+                    # the egwalker route's concurrent SUFFIX rides the
+                    # plain scan jit (never the ping-pong form — its
+                    # input is the walker stage's live output), so the
+                    # prewarm walk must compile that program per
+                    # rung x bucket too; an all-noop prewarm window is
+                    # fully critical and would never reach it
+                    table = self._apply_program(table, arrays)
             table = compact(table)
             if dummy_prev is not None:
                 pad_capacity(dummy_prev, rung)
@@ -848,11 +890,18 @@ class TpuMergeSidecar:
         declared in shapecheck.PREWARM_INDIRECT."""
         self._pool.prewarm()
 
-    def _compile_program(self, arrays: dict) -> dict:
+    def _compile_program(self, arrays: dict, base_head=None) -> dict:
         """Host half of one dispatch: raw packed arrays for the scan
-        route, the compiled chunk program for the chunked route."""
+        route, the compiled chunk program for the chunked route, the
+        event-graph program (critical prefix + concurrent suffix) for
+        the egwalker route."""
         if self.executor == "chunked":
             return compile_chunks(arrays, k_max=CHUNK_K)
+        if self.executor == "egwalker":
+            return build_event_graph(
+                arrays, base_head=base_head, k_max=EG_K,
+                window_floor=self.ladder.window_floor,
+            )
         return arrays
 
     def _apply_program(self, table, program: dict, dead=None):
@@ -869,10 +918,31 @@ class TpuMergeSidecar:
                     dead, table, program, K=CHUNK_K
                 )
             return apply_window_chunked(table, program, K=CHUNK_K)
-        batch = OpBatch(**{f: program[f] for f in OpBatch._fields})
-        if dead is not None:
-            return apply_window_pingpong(dead, table, batch)
-        return apply_window(table, batch)
+        if not program.get("egwalker"):
+            batch = OpBatch(**{f: program[f] for f in OpBatch._fields})
+            if dead is not None:
+                return apply_window_pingpong(dead, table, batch)
+            return apply_window(table, batch)
+        # egwalker: walker over every doc's critical prefix first,
+        # then the per-op scan over the concurrent suffixes (per doc
+        # the suffix strictly follows the prefix in sequenced order;
+        # across docs the stages touch disjoint lanes). Donation
+        # rides the WALKER stage; the suffix input is that stage's
+        # live output, so it always dispatches plain.
+        if program["prefix"] is not None:
+            if dead is not None:
+                table = apply_window_egwalker_pingpong(
+                    dead, table, program["prefix"], K=EG_K
+                )
+            else:
+                table = apply_window_egwalker(
+                    table, program["prefix"], K=EG_K
+                )
+        if program["suffix"] is not None:
+            table = apply_window(table, OpBatch(**{
+                f: program["suffix"][f] for f in OpBatch._fields
+            }))
+        return table
 
     def _dispatch(self) -> int:
         from ..ops.host_bridge import coalesce_noops
@@ -911,7 +981,20 @@ class TpuMergeSidecar:
             docs, {slot: ops for slot, ops in enumerate(packed) if ops},
             bucket_floor=self.ladder.window_floor,
         )
-        program = self._compile_program(arrays)
+        program = self._compile_program(
+            arrays, base_head=self._slot_head
+        )
+        if self.executor == "egwalker":
+            # advance the applied-head watermarks AFTER compiling: the
+            # program's criticality was judged against the pre-window
+            # heads (a grow re-apply reuses the compiled program, so
+            # it never re-reads these)
+            for slot, ops in enumerate(packed):
+                for op in reversed(ops):
+                    if op["kind"] != KIND_NOOP:
+                        if op["seq"] > self._slot_head[slot]:
+                            self._slot_head[slot] = op["seq"]
+                        break
         real = sum(
             1 for ops in packed for op in ops
             if op["kind"] != KIND_NOOP

@@ -14,6 +14,7 @@ from .fuzz import (
     FuzzConfig,
     record_flow_stream,
     record_op_stream,
+    record_sequential_stream,
     run_convergence_fuzz,
 )
 from .mocks import MockCollabSession
@@ -27,5 +28,6 @@ __all__ = [
     "import_as_fresh_document",
     "record_flow_stream",
     "record_op_stream",
+    "record_sequential_stream",
     "run_convergence_fuzz",
 ]

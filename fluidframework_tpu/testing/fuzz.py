@@ -94,6 +94,39 @@ def record_op_stream(cfg: FuzzConfig):
     return session.assert_converged(), stream
 
 
+def record_sequential_stream(seed: int = 0, n_clients: int = 3,
+                             n_steps: int = 100,
+                             remove_weight: float = 0.12,
+                             annotate_weight: float = 0.08):
+    """Record a FULLY-SEQUENTIAL sequenced stream: every client
+    processes everything before acting, so each op's refseq is the
+    sequenced head when it was sent — every op is critical in the
+    event-graph sense (ops/event_graph.py). This is the shape of most
+    real collaborative traffic (people rarely type at the same
+    instant in the same document) and the corpus the egwalker route's
+    fast path is measured on (bench config14 'sequential-heavy').
+    Returns (converged_text, stream)."""
+    cfg = FuzzConfig(
+        n_clients=n_clients, n_steps=n_steps,
+        insert_weight=max(0.0, 1.0 - remove_weight - annotate_weight),
+        remove_weight=remove_weight,
+        annotate_weight=annotate_weight,
+        process_weight=0.0,  # sequencing is explicit below
+        max_insert_len=6, seed=seed,
+    )
+    rng = random.Random(seed)
+    ids = [f"client-{i}" for i in range(n_clients)]
+    stream: list = []
+    session = MockCollabSession(ids, stream_log=stream)
+    for _ in range(n_steps):
+        random_op(rng, session, rng.choice(ids), cfg)
+        # the sequential contract: fully sequence + deliver after
+        # every local op, so the next op (any client) has seen it
+        session.process_all()
+    session.process_all()
+    return session.assert_converged(), stream
+
+
 def record_flow_stream(seed: int = 0, n_clients: int = 3,
                        n_steps: int = 160):
     """Record a webflow-mix sequenced stream at the merge level — the

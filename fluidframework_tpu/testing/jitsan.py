@@ -94,6 +94,10 @@ _JIT_CACHES = {
         "fluidframework_tpu.ops.merge_chunk", "_jit_cache"),
     "chunked_pingpong": (
         "fluidframework_tpu.ops.merge_chunk", "_jit_pingpong_cache"),
+    "egwalker": (
+        "fluidframework_tpu.ops.event_graph", "_jit_cache"),
+    "egwalker_pingpong": (
+        "fluidframework_tpu.ops.event_graph", "_jit_pingpong_cache"),
     "seq_shard": (
         "fluidframework_tpu.parallel.seq_shard", "_compiled_cache"),
     "mesh_pool": (
@@ -109,6 +113,8 @@ _DONATING_WRAPPERS = (
      "apply_window_pingpong", "apply_window_pingpong"),
     ("fluidframework_tpu.ops.merge_chunk",
      "apply_window_chunked_pingpong", "chunked_pingpong"),
+    ("fluidframework_tpu.ops.event_graph",
+     "apply_window_egwalker_pingpong", "egwalker_pingpong"),
     # the migration handoff consumes its SOURCE table (position 0) —
     # the pool must never read the pre-move table after the gather
     ("fluidframework_tpu.ops.shard_moves",

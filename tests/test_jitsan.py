@@ -150,6 +150,158 @@ def test_compile_counts_within_ladder_bounds_chunked_route(sanitizer):
     assert counts["apply_window"] == 0  # the scan jit stayed cold
 
 
+def test_compile_counts_within_ladder_bounds_egwalker_route(sanitizer):
+    """The third executor route: a prewarmed egwalker sidecar driven
+    through real traffic — including an overflow regrow — compiles at
+    most the shapes shapecheck derives per root. The walker jits AND
+    the plain scan jit (the concurrent-suffix program) both stay
+    within bounds; the chunked roots stay cold."""
+    ladder = BucketLadder(window_floor=16, max_bucket=32)
+    sidecar = TpuMergeSidecar(
+        max_docs=2, capacity=16, max_capacity=64, executor="egwalker",
+        donate=False, ladder=ladder,
+    )
+    sidecar.prewarm()
+    server = LocalServer()
+    _drive(server, sidecar, "doc")
+    assert sidecar.grow_count >= 1, "traffic must exercise a regrow"
+    counts = sanitizer.compile_counts()
+    bounds = ladder_bounds(16, 32, 16, 64, executor="egwalker",
+                           donate=False)
+    for root, bound in bounds.items():
+        assert counts[root] <= bound, (
+            f"{root}: {counts[root]} compiles > static ladder bound "
+            f"{bound} — an unladdered shape reached the kernel"
+        )
+    assert counts["egwalker"] > 0  # the bound check is not vacuous
+    assert counts["chunked"] == 0  # the chunked jits stayed cold
+
+
+def test_prewarm_covers_egwalker_serving_compiles(sanitizer):
+    """After prewarm, in-ladder egwalker traffic (incl. grow
+    recovery) pays ZERO mid-serve compiles — including the scan
+    SUFFIX program a concurrent window would dispatch, which an
+    all-noop prewarm window can never reach through the graph (the
+    prewarm walk compiles it explicitly)."""
+    ladder = BucketLadder(window_floor=16, max_bucket=32)
+    sidecar = TpuMergeSidecar(
+        max_docs=2, capacity=16, max_capacity=64, executor="egwalker",
+        donate=False, ladder=ladder,
+    )
+    sidecar.prewarm()
+    jitsan.reset()
+    server = LocalServer()
+    _drive(server, sidecar, "doc")
+    assert sidecar.grow_count >= 1
+    # a genuinely CONCURRENT window (two blind writers) exercises the
+    # suffix route too — prewarm must already have compiled it
+    from fluidframework_tpu.models.mergetree.ops import InsertOp
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    sidecar.track("conc", "d", "s")
+    for seq, refseq, cli in [(1, 0, "a"), (2, 0, "b"), (3, 0, "c")]:
+        sidecar.ingest("conc", SequencedMessage(
+            client_id=cli, sequence_number=seq,
+            minimum_sequence_number=0, client_sequence_number=1,
+            reference_sequence_number=refseq,
+            type=MessageType.OPERATION,
+            contents={"kind": "op", "address": "d", "channel": "s",
+                      "contents": InsertOp(pos1=0, text="zz")},
+        ))
+    sidecar.apply()
+    sidecar.sync()
+    counts = sanitizer.compile_counts()
+    assert all(n == 0 for n in counts.values()), (
+        f"mid-serve compiles after prewarm: "
+        f"{ {r: n for r, n in counts.items() if n} }"
+    )
+
+
+def test_egwalker_bounds_arithmetic():
+    """The egwalker route's bound shape: walker roots get the full
+    (window bucket x capacity rung) ladder, the suffix rides the
+    PLAIN scan root (never its ping-pong form), chunked roots are
+    zero — and the other routes pin the egwalker roots to zero."""
+    b = ladder_bounds(16, 32, 16, 64, executor="egwalker")
+    shapes = b["egwalker"]
+    assert shapes > 0
+    assert b["apply_window"] == shapes
+    assert b["apply_window_pingpong"] == 0
+    assert b["egwalker_pingpong"] == 0  # donate off
+    assert b["chunked"] == b["chunked_pingpong"] == 0
+    donating = ladder_bounds(16, 32, 16, 64, executor="egwalker",
+                             donate=True)
+    assert donating["egwalker_pingpong"] == shapes
+    assert donating["apply_window_pingpong"] == 0  # suffix stays plain
+    for other in ("scan", "chunked"):
+        cold = ladder_bounds(16, 32, 16, 64, executor=other)
+        assert cold["egwalker"] == cold["egwalker_pingpong"] == 0
+    # a POOLED egwalker (or chunked) sidecar routes pool dispatches
+    # through the chunked kernel on a degenerate mesh — the bound
+    # must grant the pool's chunked programs instead of reading a
+    # correctly laddered sidecar as a recompile storm
+    pooled = ladder_bounds(16, 32, 16, 64, executor="egwalker",
+                           pool_capacity=64, pool_rows=1)
+    assert pooled["chunked"] > 0
+    assert pooled["chunked"] == ladder_bounds(
+        16, 32, 16, 64, executor="chunked",
+        pool_capacity=64, pool_rows=1,
+    )["chunked"] - ladder_bounds(16, 32, 16, 64,
+                                 executor="chunked")["chunked"]
+    scan_pooled = ladder_bounds(16, 32, 16, 64, executor="scan",
+                                pool_capacity=64, pool_rows=1)
+    assert scan_pooled["chunked"] == 0  # scan pools ride seq_shard
+
+
+@pytest.fixture
+def cold_route_caches(monkeypatch):
+    """Fresh chunked/egwalker factory caches: both fill with FRESH
+    lambdas on miss, so an emptied dict yields genuinely cold
+    compiles — suite-order warm caches otherwise make cache-delta
+    non-vacuity asserts flaky (the cold_mesh_caches precedent)."""
+    from fluidframework_tpu.ops import event_graph, merge_chunk
+
+    monkeypatch.setattr(merge_chunk, "_jit_cache", {})
+    monkeypatch.setattr(merge_chunk, "_jit_pingpong_cache", {})
+    monkeypatch.setattr(event_graph, "_jit_cache", {})
+    monkeypatch.setattr(event_graph, "_jit_pingpong_cache", {})
+    jitsan.reset()  # baseline the fresh (empty) caches
+
+
+def test_pooled_egwalker_compile_counts_within_ladder_bounds(
+        sanitizer, cold_route_caches):
+    """The runtime half of the pooled-route bound: an egwalker
+    sidecar whose documents overflow into a degenerate seq pool
+    compiles chunked POOL programs (the deliberate egwalker->chunked
+    pool routing) and still stays within ladder_bounds per root."""
+    from fluidframework_tpu.parallel.seq_shard import make_seq_mesh
+
+    mesh = make_seq_mesh(jax.devices()[:1], doc_shards=1)
+    sidecar = TpuMergeSidecar(
+        max_docs=2, capacity=16, max_capacity=16, executor="egwalker",
+        donate=False, seq_mesh=mesh, pool_capacity=64,
+        ladder=BucketLadder(16, 16),
+    )
+    sidecar.prewarm()
+    server = LocalServer()
+    _, s = _drive(server, sidecar, "doc", n=24)
+    assert sidecar.pooled_docs() == 1, "traffic must exercise the pool"
+    assert sidecar.text("doc", "d", "s") == s.get_text()
+    counts = sanitizer.compile_counts()
+    bounds = ladder_bounds(16, 16, 16, 16, executor="egwalker",
+                           donate=False, pool_capacity=64,
+                           pool_rows=1)
+    for root, bound in bounds.items():
+        assert counts[root] <= bound, (root, counts[root], bound)
+    # non-vacuity (cold caches): the pool's chunked programs AND the
+    # primary window's walker programs both actually compiled
+    assert counts["chunked"] > 0
+    assert counts["egwalker"] > 0
+
+
 def test_ladder_arithmetic_matches_the_real_enumeration():
     """shapecheck keeps the ladder arithmetic import-free
     (_pow2_span); this pins it to the real BucketLadder enumeration
@@ -343,6 +495,37 @@ def test_static_signatures_match_eval_shape_chunked(rung, bucket):
     assert infer_kernel_output("chunked", spec) == _sig_of(out)
 
 
+def test_static_signatures_match_eval_shape_egwalker():
+    """Differential (b) for the walker root: shapecheck's abstract
+    (shape, dtype) signature == jax.eval_shape for the egwalker
+    macro-step loop across a rung x bucket sample."""
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops.event_graph import (
+        EG_K,
+        _walker_loop,
+        build_event_graph,
+    )
+    from fluidframework_tpu.ops.merge_chunk import (
+        CHUNK_FIELDS,
+        _chunk_state,
+    )
+    import numpy as np
+
+    for rung, bucket in ((16, 16), (64, 32)):
+        st = _chunk_state(make_table(4, rung))
+        spec = {f: (tuple(a.shape), str(a.dtype))
+                for f, a in st.items()}
+        arrays = {f: np.array(getattr(_batch(4, bucket), f), np.int32)
+                  for f in OpBatch._fields}
+        prefix = build_event_graph(arrays)["prefix"]
+        ops_w = {f: jnp.asarray(prefix[f])
+                 for f in OpBatch._fields + CHUNK_FIELDS}
+        out = jax.eval_shape(
+            lambda s, o: _walker_loop(s, o, EG_K), st, ops_w)
+        assert infer_kernel_output("egwalker", spec) == _sig_of(out)
+
+
 @pytest.mark.parametrize("rung", RUNGS)
 def test_static_signatures_match_eval_shape_seq_shard(rung):
     from fluidframework_tpu.parallel.seq_shard import (
@@ -432,6 +615,35 @@ def test_donated_chunked_state_reads_trap(sanitizer):
     jitsan.reset()
     apply_window_chunked_pingpong(
         None, table, build_chunked(_batch(2, 16), K=8), K=8)
+    assert sanitizer.donation_events() == []
+
+
+def test_donated_egwalker_fodder_reads_trap(sanitizer):
+    """The walker route's double-buffer contract: fodder donated to
+    apply_window_egwalker_pingpong becomes a read-trap on ANY
+    backend (CPU ignores donation; on-chip it is consumed)."""
+    import numpy as onp
+
+    from fluidframework_tpu.ops.event_graph import (
+        apply_window_egwalker_pingpong,
+        build_event_graph,
+    )
+
+    arrays = {f: onp.array(getattr(_batch(2, 16), f), onp.int32)
+              for f in OpBatch._fields}
+    prefix = build_event_graph(arrays)["prefix"]
+    table = make_table(2, 32)
+    dead = make_table(2, 32)
+    out = apply_window_egwalker_pingpong(dead, table, prefix)
+    assert [e.root for e in sanitizer.donation_events()] == [
+        "egwalker_pingpong"]
+    with pytest.raises(RuntimeError, match="deleted"):
+        # the deliberate post-donation read the trap exists to catch
+        np.asarray(dead.seq)  # fluidlint: disable=donated-buffer-reuse
+    np.asarray(out.length)
+    # dead=None is the explicit plain-dispatch opt-out: no trap
+    jitsan.reset()
+    apply_window_egwalker_pingpong(None, table, prefix)
     assert sanitizer.donation_events() == []
 
 

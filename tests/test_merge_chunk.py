@@ -6,11 +6,15 @@ scan (itself differential-fuzzed against the scalar oracle and the C++
 replayer) is the ground truth; the chunked path must reproduce its
 live rows bit-for-bit (garbage rows beyond `count` may differ: the
 sort-based restructure parks different garbage than the shift-based
-one)."""
+one). The fuzz sweeps drive the THIRD executor too: every
+``run_both`` window also runs the egwalker route
+(ops/event_graph.py), so all three executors are pinned bit-identical
+on the same streams."""
 import numpy as np
 import pytest
 
 from fluidframework_tpu.ops import build_batch, encode_stream, make_table
+from fluidframework_tpu.ops.event_graph import apply_batch_egwalker
 from fluidframework_tpu.ops.merge_chunk import (
     apply_window_chunked,
     build_chunked,
@@ -59,6 +63,10 @@ def assert_live_equal(seq_tab, chunk_tab, ctx=""):
 
 
 def run_both(streams, capacity=256, K=8):
+    """Three routes, one window: returns (scan, chunked) for the
+    call-site asserts and pins the EGWALKER route against the scan
+    inline — every fuzz sweep in this file drives all three executors
+    to bit-identical live state."""
     batch = build_batch([encode_stream(s) for s in streams])
     D = len(streams)
     seq_tab = apply_window_impl(make_table(D, capacity), batch)
@@ -66,6 +74,8 @@ def run_both(streams, capacity=256, K=8):
     chunk_tab = apply_window_chunked(
         make_table(D, capacity), chunked, K=K
     )
+    eg_tab = apply_batch_egwalker(make_table(D, capacity), batch)
+    assert_live_equal(seq_tab, eg_tab, "egwalker route")
     return seq_tab, chunk_tab
 
 

@@ -1,17 +1,19 @@
-"""Differential sidecar-level parity: scan route vs chunked route.
+"""Differential sidecar-level parity across ALL THREE executor routes.
 
-The chunked executor's bit-identical contract is pinned at the kernel
-level (tests/test_merge_chunk.py); this suite pins it at the SERVICE
-level — two sidecars on the same sequenced stream, one dispatching
-through the one-op-per-step scan (the escape hatch), one through the
-chunked macro-step executor (the default), must serve identical
-``text()`` and ``signature()`` through every policy transition: steady
-windows, the 2x regrow ladder, host eviction at the ladder top, the
-seq-sharded pool, and the one semantic divergence the executors have —
-post-overflow PARKING (the chunked executor stops applying a doc's
-window at the failing chunk while the scan keeps going; the sidecar's
-recovery re-applies the whole window from the pre-dispatch snapshot,
-which must erase the difference).
+The chunked and egwalker executors' bit-identical contracts are pinned
+at the kernel level (tests/test_merge_chunk.py, tests/
+test_event_graph.py); this suite pins them at the SERVICE level —
+three sidecars on the same sequenced stream, one per route (scan /
+chunked / egwalker), must serve identical ``text()`` and
+``signature()`` through every policy transition: steady windows, the
+2x regrow ladder, host eviction at the ladder top, the seq-sharded
+pool, and the one semantic divergence the macro-step executors have —
+post-overflow PARKING (chunked and egwalker stop applying a doc's
+window at the failing chunk/span while the scan keeps going; the
+sidecar's recovery re-applies the whole window from the pre-dispatch
+snapshot, which must erase the difference; the egwalker route
+additionally scans its concurrent SUFFIX onto a parked prefix, which
+the same recovery absorbs).
 """
 import random
 
@@ -20,12 +22,12 @@ from fluidframework_tpu.loader import Container
 from fluidframework_tpu.service import LocalServer, TpuMergeSidecar
 
 
+ROUTES = ("scan", "chunked", "egwalker")
+
+
 def _pair(**kw):
     """One sidecar per route, identical otherwise."""
-    return {
-        "scan": TpuMergeSidecar(executor="scan", **kw),
-        "chunked": TpuMergeSidecar(executor="chunked", **kw),
-    }
+    return {r: TpuMergeSidecar(executor=r, **kw) for r in ROUTES}
 
 
 def _open_doc(server, sidecars, doc, client_id=None):
@@ -40,17 +42,19 @@ def _open_doc(server, sidecars, doc, client_id=None):
 
 
 def _assert_parity(sidecars, docs, oracle=None):
-    scan, chunked = sidecars["scan"], sidecars["chunked"]
+    scan = sidecars["scan"]
     for doc in docs:
         t_scan = scan.text(doc, "d", "s")
-        t_chunked = chunked.text(doc, "d", "s")
-        assert t_scan == t_chunked, f"text route divergence on {doc}"
-        assert scan.signature(doc, "d", "s") == \
-            chunked.signature(doc, "d", "s"), (
-                f"signature route divergence on {doc}")
+        sig_scan = scan.signature(doc, "d", "s")
+        for route in ROUTES[1:]:
+            assert t_scan == sidecars[route].text(doc, "d", "s"), (
+                f"text route divergence ({route}) on {doc}")
+            assert sig_scan == sidecars[route].signature(
+                doc, "d", "s"), (
+                f"signature route divergence ({route}) on {doc}")
         if oracle is not None and doc in oracle:
-            assert t_chunked == oracle[doc].get_text(), (
-                f"both routes diverged from the oracle on {doc}")
+            assert t_scan == oracle[doc].get_text(), (
+                f"all routes diverged from the oracle on {doc}")
 
 
 def test_routes_agree_on_steady_multidoc_traffic():
@@ -84,8 +88,8 @@ def test_routes_agree_on_steady_multidoc_traffic():
     for sc in sidecars.values():
         sc.apply()
     _assert_parity(sidecars, docs, strings)
-    assert not sidecars["scan"].overflowed()
-    assert not sidecars["chunked"].overflowed()
+    for route in ROUTES:
+        assert not sidecars[route].overflowed(), route
 
 
 def test_routes_agree_through_grow_ladder():
@@ -104,10 +108,9 @@ def test_routes_agree_through_grow_ladder():
     for sc in sidecars.values():
         sc.apply()
         sc.sync()
-    assert sidecars["scan"].grow_count >= 1
-    assert sidecars["chunked"].grow_count >= 1
-    assert sidecars["scan"].host_mode_docs() == 0
-    assert sidecars["chunked"].host_mode_docs() == 0
+    for route in ROUTES:
+        assert sidecars[route].grow_count >= 1, route
+        assert sidecars[route].host_mode_docs() == 0, route
     _assert_parity(sidecars, ["doc"], {"doc": s})
 
 
@@ -129,10 +132,11 @@ def test_routes_agree_on_overflow_parking_within_one_window():
     for sc in sidecars.values():
         sc.apply()   # one dispatch: overflow mid-window on both
         sc.sync()
-    assert sidecars["scan"].grow_count >= 1
-    assert sidecars["chunked"].grow_count >= 1
+    for route in ROUTES:
+        assert sidecars[route].grow_count >= 1, route
     _assert_parity(sidecars, ["doc"], {"doc": s})
-    assert not sidecars["chunked"].overflowed()
+    for route in ROUTES:
+        assert not sidecars[route].overflowed(), route
 
 
 def test_routes_agree_through_eviction_and_recovery():
@@ -148,8 +152,8 @@ def test_routes_agree_through_eviction_and_recovery():
     for sc in sidecars.values():
         sc.apply()
         sc.sync()
-    assert sidecars["scan"].host_mode_docs() == 1
-    assert sidecars["chunked"].host_mode_docs() == 1
+    for route in ROUTES:
+        assert sidecars[route].host_mode_docs() == 1, route
     # post-eviction traffic keeps flowing on both routes
     s.insert_text(0, "MORE")
     s2.insert_text(4, "!")
@@ -180,8 +184,8 @@ def test_routes_agree_with_pool_tier():
     for sc in sidecars.values():
         sc.apply()
         sc.sync()
-    assert sidecars["scan"].pooled_docs() == 1
-    assert sidecars["chunked"].pooled_docs() == 1
+    for route in ROUTES:
+        assert sidecars[route].pooled_docs() == 1, route
     # pooled docs keep collaborating through the pool dispatch path
     for i in range(4):
         s.insert_text(0, "Q")
