@@ -2640,6 +2640,23 @@ def _fluidlint_counts() -> dict | None:
     return _FLUIDLINT_CACHE
 
 
+def _wire_schema_hash() -> str | None:
+    """Content hash of the WIRE_SCHEMA registry
+    (protocol/constants.py) — rides every stage record next to
+    fluidlint_findings so a cross-PR frame-schema change surfaces as
+    a BENCH_* delta, not just as the WIRE_SCHEMA.json golden diff.
+    None if protocol fails to import (best-effort, like the lint
+    counts)."""
+    try:
+        from fluidframework_tpu.protocol.constants import (
+            wire_schema_hash,
+        )
+
+        return wire_schema_hash()
+    except Exception:  # noqa: BLE001 - the hash is best-effort
+        return None
+
+
 def _registry_snapshot() -> dict | None:
     """The obs metrics registry, or None if obs failed to import (a
     broken registry must not lose a measured stage)."""
@@ -2688,6 +2705,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         # free because each stage runs in its own subprocess
         "metrics_registry": _registry_snapshot(),
         "fluidlint_findings": _fluidlint_counts(),
+        "wire_schema_hash": _wire_schema_hash(),
         "jax_compiles": jax_compiles,
     })
     # persist the full-scale result BEFORE the fixed-scale companion:
@@ -2712,6 +2730,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         fixed["jax_compiles"] = _jax_compiles()
         fixed["metrics_registry"] = _registry_snapshot()
         fixed["fluidlint_findings"] = _fluidlint_counts()
+        fixed["wire_schema_hash"] = _wire_schema_hash()
         result["fixed_scale"] = fixed
         with open(out_path, "w") as f:
             json.dump(result, f)

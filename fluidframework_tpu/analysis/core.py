@@ -200,7 +200,8 @@ def load_allowlist(path: str = ALLOWLIST_PATH) -> list[tuple[str, str]]:
 
 
 FAMILIES = ("layercheck", "jaxhazards", "lockcheck", "obscheck",
-            "qoscheck", "concheck", "shapecheck", "detcheck")
+            "qoscheck", "concheck", "shapecheck", "detcheck",
+            "wirecheck")
 
 # rule id -> owning family: tooling that groups ONE combined run's
 # findings per family (bench's fluidlint_findings records) reads
@@ -222,6 +223,9 @@ FAMILY_RULES = {
                    "prewarm-coverage"),
     "detcheck": ("wall-clock-unrouted", "unseeded-rng",
                  "iteration-order-leak", "hash-order-dependence"),
+    "wirecheck": ("encoder-decoder-drift",
+                  "optional-field-unconditional-emit",
+                  "ungated-wire-read", "unversioned-frame-field"),
 }
 RULE_FAMILY = {
     rule: fam for fam, rules in FAMILY_RULES.items() for rule in rules
@@ -244,6 +248,7 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
         obscheck,
         qoscheck,
         shapecheck,
+        wirecheck,
     )
 
     passes = {
@@ -255,6 +260,7 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
         "concheck": concurrency.check,
         "shapecheck": shapecheck.check,
         "detcheck": determinism.check,
+        "wirecheck": wirecheck.check,
     }
     unknown = [f for f in families if f not in passes]
     if unknown:
@@ -264,11 +270,11 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
     files = walk_python_files(roots, repo_root)
     findings: list[Finding] = []
     by_path = {f.relpath: f for f in files}
-    # one shared call graph per run: jaxhazards, concheck, shapecheck
-    # and detcheck resolve through the same interprocedural edges
-    # (and pay for the build once)
+    # one shared call graph per run: jaxhazards, concheck, shapecheck,
+    # detcheck and wirecheck resolve through the same interprocedural
+    # edges (and pay for the build once)
     GRAPH_FAMILIES = ("jaxhazards", "concheck", "shapecheck",
-                      "detcheck")
+                      "detcheck", "wirecheck")
     shared_graph = None
     if set(GRAPH_FAMILIES) & set(families):
         from .callgraph import build_callgraph

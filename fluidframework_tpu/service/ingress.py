@@ -173,13 +173,15 @@ def nack_to_json(nack: Nack) -> dict:
         "sequence_number": nack.sequence_number,
         "error_type": int(nack.error_type),
         "message": nack.message,
-        "retry_after_seconds": nack.retry_after_seconds,
         "operation": document_message_to_json(nack.operation)
         if nack.operation is not None else None,
     }
-    # qos shed attribution is OPTIONAL on the wire: emitted only when
-    # set, so pre-qos nack frames stay byte-identical and 1.0/1.1
-    # peers never see keys they don't know (test_wire_compat)
+    # retry_after_seconds and the qos shed attribution are OPTIONAL
+    # on the wire: emitted only when set, so non-throttle nack frames
+    # stay byte-identical to the 1.0 shape and older peers never see
+    # keys they don't know (test_wire_compat)
+    if nack.retry_after_seconds is not None:
+        out["retry_after_seconds"] = nack.retry_after_seconds
     if nack.pressure_tier is not None:
         out["pressure_tier"] = nack.pressure_tier
     if nack.shed_class is not None:
@@ -629,18 +631,25 @@ class AlfredServer:
             ))
             return
         _ERRORS_OUT.inc()
-        session.send({
+        out = {
             "type": "error",
             "rid": frame.get("rid"),
             "error_kind": "throttle",
-            "retry_after_seconds": adm.retry_after_seconds,
-            "pressure_tier": adm.tier,
-            "shed_class": adm.shed_class,
             "message": (
                 f"throttled ({adm.reason}): retry after "
                 f"{adm.retry_after_seconds:.3f}s"
             ),
-        })
+        }
+        # optional-presence wire fields: a throttle error omits the
+        # retry hint / shed attribution it has nothing to say about,
+        # same discipline as nack_to_json
+        if adm.retry_after_seconds is not None:
+            out["retry_after_seconds"] = adm.retry_after_seconds
+        if adm.tier is not None:
+            out["pressure_tier"] = adm.tier
+        if adm.shed_class is not None:
+            out["shed_class"] = adm.shed_class
+        session.send(out)
 
     def _dispatch(self, session: _ClientSession, frame: dict,
                   nbytes: int = 0) -> None:

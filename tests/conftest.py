@@ -36,17 +36,22 @@ import pytest  # noqa: E402
 # the kernel jit caches and arms the donation read-traps. detsan
 # (testing/detsan.py) rides it too: patched time/random entry points
 # trip on un-routed clock reads / unseeded RNG draws inside
-# deterministic-plane components. The autouse guard below fails any
-# test that trips any of the three.
+# deterministic-plane components. wiresan (testing/wiresan.py)
+# completes the set: the patched pack/dispatch wire seams trip on any
+# registered frame type carrying a field absent from the WIRE_SCHEMA
+# registry. The autouse guard below fails any test that trips any of
+# the four.
 _SANITIZE = os.environ.get("FFTPU_SANITIZE") == "1"
 if _SANITIZE:
     from fluidframework_tpu.testing import detsan as _detsan
     from fluidframework_tpu.testing import jitsan as _jitsan
     from fluidframework_tpu.testing import sanitizer as _fluidsan
+    from fluidframework_tpu.testing import wiresan as _wiresan
 
     _fluidsan.install()
     _jitsan.install()
     _detsan.install()
+    _wiresan.install()
 
 
 @pytest.fixture(autouse=True)
@@ -54,11 +59,14 @@ def _fluidsan_trip_guard():
     if not _SANITIZE:
         yield
         return
-    from fluidframework_tpu.testing import detsan, jitsan, sanitizer
+    from fluidframework_tpu.testing import (
+        detsan, jitsan, sanitizer, wiresan,
+    )
 
     before = len(sanitizer.trips())
     before_jit = len(jitsan.trips())
     before_det = len(detsan.trips())
+    before_wire = len(wiresan.trips())
     yield
     fresh = sanitizer.trips()[before:]
     if fresh:
@@ -79,6 +87,12 @@ def _fluidsan_trip_guard():
             "detsan tripped during this test:\n"
             + "\n".join(t.describe() for t in fresh_det)
             + "\n" + fresh_det[0].flight_dump
+        )
+    fresh_wire = wiresan.trips()[before_wire:]
+    if fresh_wire:
+        pytest.fail(
+            "wiresan tripped during this test:\n"
+            + "\n".join(t.describe() for t in fresh_wire)
         )
 
 

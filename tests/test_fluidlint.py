@@ -1824,9 +1824,33 @@ def test_finding_keys_are_line_free_across_all_families(tmp_path):
                     pending = set(op.targets)
                     return list(pending), part, t1 - t0, jitter
         """,
+        # wirecheck: all four wire rules fire against a mini
+        # registry — unguarded optional emit (trace), ungated
+        # post-1.0 read (b), unregistered field + whole type
+        # (mystery, zap), emit-side drift (dead)
+        "fluidframework_tpu/protocol/constants.py": """
+            WIRE_SCHEMA = {
+                "ping": {"a": "1.0", "b": "1.1", "trace": "1.1?",
+                         "dead": "1.0"},
+            }
+        """,
+        "fluidframework_tpu/service/ingress.py": """
+            def send(session, a, b, t, m):
+                session.send({
+                    "type": "ping", "a": a, "b": b, "trace": t,
+                    "mystery": m, "dead": m,
+                })
+                session.send({"type": "zap", "z": 1})
+
+            def deliver(frame):
+                if frame.get("type") == "ping":
+                    return (frame["a"], frame["b"],
+                            frame.get("trace"))
+        """,
     }
     key_families = ["layercheck", "jaxhazards", "lockcheck",
-                    "qoscheck", "concheck", "shapecheck", "detcheck"]
+                    "qoscheck", "concheck", "shapecheck", "detcheck",
+                    "wirecheck"]
     baseline = _lint(tmp_path, dict(files), families=key_families)
     assert len(baseline) >= 5
     assert {"donated-buffer-reuse", "unladdered-jit-shape",
@@ -1834,6 +1858,14 @@ def test_finding_keys_are_line_free_across_all_families(tmp_path):
     assert {"wall-clock-unrouted", "unseeded-rng",
             "iteration-order-leak",
             "hash-order-dependence"} <= _rules(baseline)
+    assert {"encoder-decoder-drift",
+            "optional-field-unconditional-emit", "ungated-wire-read",
+            "unversioned-frame-field"} <= _rules(baseline)
+    wire_keys = sorted(
+        f.key for f in baseline
+        if f.rule == "unversioned-frame-field")
+    assert wire_keys == ["ingress.py:send:ping.mystery",
+                         "ingress.py:send:zap"]
     det_keys = sorted(
         f.key for f in baseline if f.rule == "wall-clock-unrouted")
     # qualname-ordinal keys: the second raw read in the same scope

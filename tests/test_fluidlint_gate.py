@@ -570,6 +570,10 @@ def test_detcheck_family_is_in_the_gate():
     assert "detcheck" in core.FAMILIES
 
 
+def test_wirecheck_family_is_in_the_gate():
+    assert "wirecheck" in core.FAMILIES
+
+
 def test_wall_clock_unrouted_rule(tmp_path):
     """detcheck:wall-clock-unrouted — a direct time.* read reachable
     from a deterministic-contract root (here: a fixture matching the
@@ -777,6 +781,47 @@ def test_detcheck_live_tree_is_clean_with_empty_allowlist():
     )
 
 
+def test_wirecheck_live_tree_is_clean_with_empty_allowlist():
+    """The acceptance bar (the PR1/PR5/PR11 precedent): zero live
+    wirecheck findings over the whole repo and NOTHING grandfathered
+    — the unguarded optional emits the family found live (the nack
+    retry hint in nack_to_json, the throttle error's qos attribution
+    in _send_shed) were FIXED in the PR that introduced it. The
+    WIRE_SCHEMA registry's '?'/'~' flags are the reviewed escape
+    hatch, not the allowlist."""
+    kept, _stale, allowlist = _gate()
+    wire_rules = set(core.FAMILY_RULES["wirecheck"])
+    wire_kept = [f for f in kept if f.rule in wire_rules]
+    assert wire_kept == [], \
+        "\n".join(f.format() for f in wire_kept)
+    grandfathered = [e for e in allowlist if e[0] in wire_rules]
+    assert grandfathered == [], (
+        "wirecheck findings must be fixed, never grandfathered: "
+        f"{grandfathered}"
+    )
+
+
+def test_wire_schema_registry_resolves_to_live_traffic():
+    """Registry non-vacuity (the WALL_CLOCK_SINKS contract): every
+    non-tolerated WIRE_SCHEMA entry must still name a field some
+    in-scope encoder emits or decoder reads — ghost vocabulary fails
+    HERE so the registry can only describe the live protocol. (The
+    staleness detector's own non-vacuity is pinned by
+    test_wirecheck.py's ghost-entry fixture; the registry is a pure
+    literal in the scanned tree, so there is nothing to monkeypatch
+    live.)"""
+    from fluidframework_tpu.analysis import wirecheck
+
+    files = core.walk_python_files(["fluidframework_tpu"])
+    stale = wirecheck.stale_schema_entries(files)
+    assert stale == [], (
+        "stale WIRE_SCHEMA entries (no emit or read resolves to "
+        f"them anymore — delete or mark '~'): {stale}"
+    )
+    registry = wirecheck.load_registry(files)
+    assert registry, "WIRE_SCHEMA registry unexpectedly empty"
+
+
 def test_wall_clock_sinks_registry_resolves_to_live_sites():
     """Registry non-vacuity (the FANOUT_GATES contract): every
     WALL_CLOCK_SINKS entry must still name a function (or module)
@@ -816,7 +861,10 @@ def test_family_rules_map_stays_complete():
                  "unladdered-jit-shape", "kernel-dtype-widen",
                  "shape-mismatch", "prewarm-coverage",
                  "wall-clock-unrouted", "unseeded-rng",
-                 "iteration-order-leak", "hash-order-dependence"):
+                 "iteration-order-leak", "hash-order-dependence",
+                 "encoder-decoder-drift",
+                 "optional-field-unconditional-emit",
+                 "ungated-wire-read", "unversioned-frame-field"):
         assert rule in core.RULE_FAMILY, rule
 
 
@@ -854,7 +902,7 @@ def test_shapecheck_live_tree_is_clean_within_the_ratchet():
 
 
 def test_combined_gate_run_stays_under_budget():
-    """The CI/tooling satellite: eight families, one shared
+    """The CI/tooling satellite: nine families, one shared
     callgraph, one budget. A blowup here means a family stopped
     reusing the per-run graph or a fixpoint regressed superlinear."""
     _gate()  # ensures the timed run happened (memoized per session)

@@ -1,0 +1,431 @@
+"""wirecheck unit tests: per rule, a true-positive fixture (the
+analyzer catches the planted wire defect) and a clean-pass fixture
+(the idiomatic shape sails through), plus the interprocedural
+machinery the live findings depended on — ``**helper()`` expansion,
+request/response typing, version-gate inheritance — and the
+suppression / registry-staleness contracts. Fixture trees carry
+their OWN mini ``protocol/constants.py``: the pass reads WIRE_SCHEMA
+from the scanned tree's AST, never from the live package.
+"""
+import textwrap
+
+from fluidframework_tpu.analysis import wirecheck
+from fluidframework_tpu.analysis.core import (
+    run_analysis,
+    walk_python_files,
+)
+
+
+def _lint(tmp_path, files):
+    for relpath, source in files.items():
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    return run_analysis(
+        roots=sorted({p.split("/")[0] for p in files}),
+        families=["wirecheck"],
+        repo_root=str(tmp_path),
+    )
+
+
+def _constants(schema: str, gate: bool = False) -> str:
+    src = "WIRE_SCHEMA = " + textwrap.dedent(schema).strip() + "\n"
+    if gate:
+        src += "def wire_version_lt(a, b):\n    return a < b\n"
+    return src
+
+
+# ------------------------------------------------- unversioned-frame-field
+
+
+def test_unversioned_frame_field_rule(tmp_path):
+    """An emitted field absent from the registry — or a whole frame
+    type the registry has never heard of — fails the gate; registered
+    emits pass; a justified inline disable suppresses."""
+    findings = _lint(tmp_path, {
+        "protocol/constants.py": _constants("""
+            {
+                "ping": {"a": "1.0"},
+            }
+        """),
+        "service/ingress.py": """
+            def send(session, a, m, s):
+                session.send({"type": "ping", "a": a})          # ok
+                session.send({"type": "ping", "a": a,
+                              "mystery": m})                    # BAD
+                session.send({"type": "zap", "z": 1})           # BAD
+                session.send({"type": "ping", "sneaky": s})  # fluidlint: disable=unversioned-frame-field -- test
+            def deliver(frame):
+                if frame.get("type") == "ping":
+                    return frame["a"]
+        """,
+    })
+    assert sorted(f.key for f in findings) == [
+        "ingress.py:send:ping.mystery",
+        "ingress.py:send:zap",
+    ]
+    assert all(f.rule == "unversioned-frame-field" for f in findings)
+
+
+def test_no_registry_in_scope_means_no_contract(tmp_path):
+    """A scan scope with wire modules but no protocol/constants.py
+    registry checks nothing (partial-path CLI runs; the live gate
+    always scans the real constants module)."""
+    assert _lint(tmp_path, {
+        "service/ingress.py": """
+            def send(session, x):
+                session.send({"type": "anything", "x": x})
+        """,
+    }) == []
+
+
+# ------------------------------------- optional-field-unconditional-emit
+
+
+def test_optional_field_unconditional_emit_rule(tmp_path):
+    """A '?'-flagged field emitted with a maybe-None value and no
+    guard fails; the guarded-augmentation idiom, an emit nested under
+    ``if``, and constant (never-None) values all pass."""
+    findings = _lint(tmp_path, {
+        "protocol/constants.py": _constants("""
+            {
+                "ping": {"a": "1.0", "trace": "1.1?",
+                         "hint": "1.1?"},
+            }
+        """),
+        "service/ingress.py": """
+            def send_bad(session, a, t):
+                session.send({"type": "ping", "a": a,
+                              "trace": t})                      # BAD
+            def send_guarded(session, a, t, h):
+                out = {"type": "ping", "a": a}
+                if t is not None:
+                    out["trace"] = t                            # ok
+                if h:
+                    out["hint"] = h                             # ok
+                session.send(out)
+            def send_nested(session, a, t):
+                if t is not None:
+                    session.send({"type": "ping", "a": a,
+                                  "trace": t})                  # ok
+            def send_const(session, a):
+                session.send({"type": "ping", "a": a,
+                              "hint": "fixed"})                 # ok
+            def deliver(frame):
+                if frame.get("type") == "ping":
+                    return (frame["a"], frame.get("trace"),
+                            frame.get("hint"))
+        """,
+    })
+    assert [f.key for f in findings] == [
+        "ingress.py:send_bad:ping.trace",
+    ]
+    assert findings[0].rule == "optional-field-unconditional-emit"
+
+
+# --------------------------------------------------- ungated-wire-read
+
+
+def test_ungated_wire_read_rule(tmp_path):
+    """A bare subscript read of a post-1.0 (or optional-presence)
+    field fails; ``.get()``, a presence check on the same field, a
+    direct ``wire_version_lt`` gate, and a gate inherited through a
+    gate-providing helper all pass; 1.0 required fields may be read
+    bare."""
+    findings = _lint(tmp_path, {
+        "protocol/constants.py": _constants("""
+            {
+                "pong": {"b": "1.0", "status": "1.1",
+                         "extra": "1.0?"},
+            }
+        """, gate=True),
+        "service/ingress.py": """
+            def reply(session, b, status, extra):
+                out = {"type": "pong", "b": b, "status": status}
+                if extra is not None:
+                    out["extra"] = extra
+                session.send(out)
+        """,
+        "drivers/socket_driver.py": """
+            from ..protocol.constants import wire_version_lt
+
+            class Client:
+                def deliver(self, frame):
+                    if frame.get("type") == "pong":
+                        bad = frame["status"]                   # BAD
+                        bad2 = frame["extra"]                   # BAD
+                        ok = frame.get("status")                # ok
+                        ok0 = frame["b"]                        # ok 1.0
+                        if frame.get("extra") is not None:
+                            ok2 = frame["extra"]                # ok
+                        return bad, bad2, ok, ok0
+
+                def _gated(self, agreed):
+                    return wire_version_lt(agreed, "1.1")
+
+                def deliver_gated(self, frame, agreed):
+                    if frame.get("type") == "pong":
+                        if wire_version_lt(agreed, "1.1"):
+                            raise ValueError("downlevel")
+                        return frame["status"]                  # ok
+                def deliver_helper_gated(self, frame, agreed):
+                    if frame.get("type") == "pong":
+                        if self._gated(agreed):
+                            raise ValueError("downlevel")
+                        return frame["status"]                  # ok
+        """,
+    })
+    assert sorted(f.key for f in findings) == [
+        "socket_driver.py:Client.deliver:pong.extra",
+        "socket_driver.py:Client.deliver:pong.status",
+    ]
+    assert all(f.rule == "ungated-wire-read" for f in findings)
+
+
+def test_gate_inheritance_through_calls(tmp_path):
+    """A decoder called FROM a gate-covered site inherits the gate
+    (the upload_summary -> _doc_upload_summary shape); the same
+    decoder reached without a gate fails."""
+    findings = _lint(tmp_path, {
+        "protocol/constants.py": _constants("""
+            {
+                "summary_uploaded": {"handle": "1.1"},
+            }
+        """, gate=True),
+        "service/ingress.py": """
+            def finish(session, h):
+                session.send({"type": "summary_uploaded",
+                              "handle": h})
+        """,
+        "drivers/socket_driver.py": """
+            from ..protocol.constants import wire_version_lt
+
+            class Client:
+                def poll(self, frame, agreed):
+                    if frame.get("type") == "summary_uploaded":
+                        if wire_version_lt(agreed, "1.1"):
+                            raise ValueError("downlevel")
+                        return self._finish(frame)
+
+                def _finish(self, frame):
+                    return frame["handle"]                      # ok
+
+            class BadClient:
+                def poll(self, frame):
+                    if frame.get("type") == "summary_uploaded":
+                        return self._finish_bad(frame)
+
+                def _finish_bad(self, frame):
+                    return frame["handle"]                      # BAD
+        """,
+    })
+    assert [f.key for f in findings] == [
+        "socket_driver.py:BadClient._finish_bad:"
+        "summary_uploaded.handle",
+    ]
+    assert findings[0].rule == "ungated-wire-read"
+
+
+# ----------------------------------------------- encoder-decoder-drift
+
+
+def test_encoder_decoder_drift_rule(tmp_path):
+    """Emit-side: a field the encoder puts on the wire that no
+    decoder consumes is dead freight. Read-side: a bare-subscript
+    read of a field nothing emits KeyErrors on well-formed peers.
+    '~' (tolerated) registry entries and guarded reads pass."""
+    findings = _lint(tmp_path, {
+        "protocol/constants.py": _constants("""
+            {
+                "ping": {"a": "1.0", "dead": "1.0",
+                         "aux": "1.0~"},
+                "pong": {"b": "1.0", "need": "1.0"},
+            }
+        """),
+        "service/ingress.py": """
+            def send(session, a, d, x):
+                session.send({"type": "ping", "a": a,
+                              "dead": d,                        # BAD
+                              "aux": x})                        # ok ~
+            def handle(frame):
+                if frame.get("type") == "pong":
+                    return frame["need"], frame.get("b")        # BAD
+        """,
+        "drivers/socket_driver.py": """
+            def deliver(frame):
+                if frame.get("type") == "ping":
+                    return frame["a"], frame.get("gone")        # ok
+        """,
+    })
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.key)
+    assert by_rule == {"encoder-decoder-drift": [
+        # emit-side: ping.dead emitted but never consumed
+        "ingress.py:send:ping.dead",
+        # read-side: pong.need required but nothing emits pong
+        "ingress.py:handle:pong.need",
+    ]}
+    # ping.gone: a GUARDED read of a never-emitted field is the
+    # tolerant-decoder idiom, not drift (and not rule 4: rule 4 is
+    # about emits)
+
+
+# ------------------------------------------- interprocedural machinery
+
+
+def test_star_expansion_resolves_through_callgraph(tmp_path):
+    """``{"type": "nack", **nack_json(n)}`` merges the helper's
+    return schema into the frame (the nack_to_json shape): registered
+    fields pass, an unregistered field in the HELPER is reported at
+    the helper's own line, and the helper's guarded augmentation
+    satisfies the optional-presence rule."""
+    findings = _lint(tmp_path, {
+        "protocol/constants.py": _constants("""
+            {
+                "nack": {"document_id": "1.0", "seq": "1.0",
+                         "tier": "1.1?"},
+            }
+        """),
+        "service/ingress.py": """
+            def nack_json(n):
+                out = {"seq": n.seq}
+                if n.tier is not None:
+                    out["tier"] = n.tier                        # ok
+                out["surprise"] = n.surprise                    # BAD
+                return out
+            def send(session, doc, n):
+                session.send({"type": "nack", "document_id": doc,
+                              **nack_json(n)})
+            def deliver(frame):
+                if frame.get("type") == "nack":
+                    return (frame["document_id"], frame["seq"],
+                            frame.get("tier"))
+        """,
+    })
+    assert [(f.rule, f.key, f.path) for f in findings] == [(
+        "unversioned-frame-field",
+        "ingress.py:nack_json:nack.surprise",
+        "service/ingress.py",
+    )]
+
+
+def test_request_response_typing(tmp_path):
+    """``frame = self._request(data)`` types the reply by the request
+    dict's frame type (RESPONSE_OF): a bare read of a post-1.0
+    response field fails, the presence-guard-with-early-return idiom
+    passes."""
+    findings = _lint(tmp_path, {
+        "protocol/constants.py": _constants("""
+            {
+                "fetch_summary": {"document_id": "1.0"},
+                "summary": {"sequence_number": "1.0",
+                            "summary": "1.1"},
+            }
+        """),
+        "service/ingress.py": """
+            def handle(session, frame, seq, blob):
+                if frame.get("type") == "fetch_summary":
+                    doc = frame["document_id"]
+                    session.send({"type": "summary",
+                                  "sequence_number": seq,
+                                  "summary": blob})
+        """,
+        "drivers/socket_driver.py": """
+            class Service:
+                def _request(self, data):
+                    raise NotImplementedError
+
+                def latest(self, doc):
+                    data = {"type": "fetch_summary",
+                            "document_id": doc}
+                    frame = self._request(data)
+                    if frame.get("sequence_number") is None:
+                        return None
+                    return (frame["sequence_number"],           # ok
+                            frame["summary"])                   # BAD
+        """,
+    })
+    assert [(f.rule, f.key) for f in findings] == [(
+        "ungated-wire-read",
+        "socket_driver.py:Service.latest:summary.summary",
+    )]
+
+
+def test_subclass_override_receives_propagated_types(tmp_path):
+    """``self._on_frame(frame)`` in a base class propagates the frame
+    type to SUBCLASS overrides too (the MultiplexedSocketClient
+    shape) — the callgraph alone only walks up the base chain."""
+    findings = _lint(tmp_path, {
+        "protocol/constants.py": _constants("""
+            {
+                "connected": {"document_id": "1.0",
+                              "epoch": "1.1"},
+            }
+        """),
+        "service/ingress.py": """
+            def ack(session, doc, epoch):
+                session.send({"type": "connected",
+                              "document_id": doc,
+                              "epoch": epoch})
+        """,
+        "drivers/socket_driver.py": """
+            class Base:
+                def loop(self, frame):
+                    if frame.get("type") == "connected":
+                        self._on_frame(frame)
+
+                def _on_frame(self, frame):
+                    return frame.get("document_id")
+
+            class Multiplexed(Base):
+                def _on_frame(self, frame):
+                    return frame["epoch"]                       # BAD
+        """,
+    })
+    assert [(f.rule, f.key) for f in findings] == [(
+        "ungated-wire-read",
+        "socket_driver.py:Multiplexed._on_frame:connected.epoch",
+    )]
+
+
+# ----------------------------------------------- registry staleness
+
+
+def test_stale_schema_entries_detects_ghost_vocabulary(tmp_path):
+    """Registry non-vacuity (the WALL_CLOCK_SINKS / CANONICAL_HOPS
+    contract): a non-'~' entry that the extractor finds neither
+    emitted nor read anywhere is ghost vocabulary; '~' entries are
+    exempt (they exist precisely for out-of-scope traffic)."""
+    files = {
+        "protocol/constants.py": _constants("""
+            {
+                "ping": {"a": "1.0", "ghost": "1.0",
+                         "aux": "1.0~"},
+            }
+        """),
+        "service/ingress.py": """
+            def send(session, a):
+                session.send({"type": "ping", "a": a})
+            def deliver(frame):
+                if frame.get("type") == "ping":
+                    return frame["a"]
+        """,
+    }
+    for relpath, source in files.items():
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    scanned = walk_python_files(
+        sorted({p.split("/")[0] for p in files}),
+        repo_root=str(tmp_path))
+    assert wirecheck.stale_schema_entries(scanned) == [
+        ("ping", "ghost"),
+    ]
+
+
+def test_spec_parser_flags():
+    assert wirecheck.parse_spec("1.0") == ("1.0", False, False)
+    assert wirecheck.parse_spec("1.1?") == ("1.1", True, False)
+    assert wirecheck.parse_spec("1.0~") == ("1.0", False, True)
+    assert wirecheck.parse_spec("1.1?~") == ("1.1", True, True)
