@@ -42,7 +42,8 @@ import time
 
 STAGES = ("probe", "fuzz", "config1", "config2", "config3", "config4",
           "config5", "config6", "config7", "config8", "config9",
-          "config10", "config11", "config12", "config13", "config14")
+          "config10", "config11", "config12", "config13", "config14",
+          "config15")
 
 # Machine-readable corpus identity, stamped into EVERY stage record
 # (r5 silently changed the stream mix — flow-mix quarter joined — and
@@ -67,7 +68,10 @@ STAGE_CORPUS = {
     "config11": {"generator": "chaos-standard", "version": 1},
     "config12": {"generator": "chaos-failover", "version": 1},
     "config13": {"generator": "chaos-netsplit", "version": 1},
-    "config14": {"generator": "route-tri-corpus", "version": 1},
+    "config14": {"generator": "route-tri-corpus", "version": 2,
+                 "changed": "r6: remove-heavy quarter joined "
+                            "(event-splitting evidence)"},
+    "config15": {"generator": "columnar-pack-mix", "version": 1},
 }
 
 
@@ -2380,6 +2384,12 @@ def stage_config14(scale: str, reps: int, cooldown: float) -> dict:
                         0.05: almost every op concurrent — the walker
                         degenerates to its scan suffix)
       mixed             the standard bench fuzz mix (process 0.15)
+      remove_heavy      sequential editing at remove_weight 0.45 —
+                        the corpus where committed-tombstone aging
+                        boundaries land mid-span, so event-splitting
+                        (ops/event_graph.py) is what keeps the span
+                        chain short; span_splits_per_doc in the graph
+                        stats is the direct evidence
 
     plus the scalar-Python and C++ -O2 proxy baselines on the same
     streams. Per corpus the record carries per-route ops/s, the
@@ -2408,6 +2418,7 @@ def stage_config14(scale: str, reps: int, cooldown: float) -> dict:
     from fluidframework_tpu.service.tpu_sidecar import (
         TpuMergeSidecar,
         default_executor,
+        executor_flip,
     )
     from fluidframework_tpu.testing import (
         FuzzConfig,
@@ -2427,6 +2438,10 @@ def stage_config14(scale: str, reps: int, cooldown: float) -> dict:
             if kind == "sequential":
                 _, stream = record_sequential_stream(
                     seed=14000 + i, n_clients=clients, n_steps=steps)
+            elif kind == "remove_heavy":
+                _, stream = record_sequential_stream(
+                    seed=14300 + i, n_clients=clients, n_steps=steps,
+                    remove_weight=0.45)
             elif kind == "concurrent":
                 _, stream = record_op_stream(FuzzConfig(
                     n_clients=max(clients, 4), n_steps=steps,
@@ -2509,6 +2524,13 @@ def stage_config14(scale: str, reps: int, cooldown: float) -> dict:
         return {
             "critical_fraction": round(crit, 4),
             "walker_spans_per_doc": round(spans, 1),
+            # events split (not broken into extra spans) at
+            # min_seq-aging / committed-tombstone boundaries: each
+            # split is exactly one span break absorbed, so with
+            # splitting on, walker_spans_per_doc sits strictly BELOW
+            # the pre-split count by this amount
+            "span_splits_per_doc": round(
+                float(program["span_splits"].sum() / base), 1),
             "chunked_chunks_per_doc": round(chunks, 1),
             "docs_with_concurrent_suffix": int(
                 (g.prefix_len < np.int32(W)).sum()),
@@ -2521,10 +2543,14 @@ def stage_config14(scale: str, reps: int, cooldown: float) -> dict:
         "round_ops": round_ops,
         "capacity": capacity,
         "executor_route": default_executor(),
+        # the data-driven default decision AND its inputs (recorded
+        # launches/window per route x the launch cost) — the flip is
+        # auditable from the record alone, not a constant to trust
+        "executor_flip": executor_flip(),
         "corpora": {},
     }
     kernel_best = 0.0
-    for kind in ("sequential", "concurrent", "mixed"):
+    for kind in ("sequential", "concurrent", "mixed", "remove_heavy"):
         raw, encs = corpus_streams(kind)
         per_route = {}
         sidecars = {}
@@ -2579,6 +2605,175 @@ def stage_config14(scale: str, reps: int, cooldown: float) -> dict:
             seq["chunked"]["ops_per_sec"], (
                 "config14: the egwalker route must beat chunked on "
                 f"the sequential-heavy corpus on CPU, got {seq}")
+    # event-splitting acceptance: the remove-heavy corpus must
+    # actually exercise splits (each one is a span break absorbed, so
+    # a positive count == walker_spans_per_doc strictly lower than
+    # the pre-split chain). Corpus-structural, so backend-independent.
+    rh = record["corpora"]["remove_heavy"]["graph"]
+    assert rh["span_splits_per_doc"] > 0, (
+        "config14: remove-heavy corpus produced no event splits — "
+        f"the span chain is not being split, got {rh}")
+    return record
+
+
+def stage_config15(scale: str, reps: int, cooldown: float) -> dict:
+    """Pack-stage microbench (the wire-1.3 columnar ingress PR):
+    host-side ops/s for the two submitOp ingest paths at three batch
+    sizes, timed decode->lower->pack (frame parsing excluded — both
+    forms arrive pre-parsed, exactly what the read loop hands the
+    dispatcher):
+
+      row decode  the 1.0-1.2 boxcar: per-op JSON -> DocumentMessage
+                  -> sequence stamp -> DocStream._add_op dict rows ->
+                  pack_rows' fromiter pass
+      columnar    the 1.3 payload IS the column layout: validated
+                  once, sliced to one [n, 12] int32 block
+                  (host_bridge.lower_columns), pack_rows degrades to
+                  array concatenation — zero per-op Python
+
+    Pure host work: no jax, identical numbers on either backend.
+
+    ACCEPTANCE (non-smoke): the columnar path must be >=5x the row
+    path's ops/s at the largest batch size — asserted below, after a
+    bit-identity differential proves both paths pack the same window.
+    """
+    import random
+
+    import numpy as np
+
+    from fluidframework_tpu.models.mergetree.ops import (
+        InsertOp,
+        RemoveOp,
+    )
+    from fluidframework_tpu.ops.host_bridge import (
+        DocStream,
+        OP_FIELDS,
+        lower_columns,
+        pack_rows,
+    )
+    from fluidframework_tpu.protocol.columnar import (
+        encode_columns,
+        validate_columns,
+    )
+    from fluidframework_tpu.protocol.constants import mark_batch
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+        SequencedMessage,
+    )
+    from fluidframework_tpu.service.ingress import (
+        document_message_from_json,
+        document_message_to_json,
+    )
+
+    total_ops = {"full": 131072, "cpu": 49152, "smoke": 4096}[scale]
+    sizes = (8, 64, 512)
+
+    def make_batch(n: int, seed: int):
+        """One columnar-expressible batch (plain INSERT/REMOVE, one
+        client, untraced) in BOTH wire forms, pre-built outside the
+        timed region."""
+        rng = random.Random(seed)
+        ops, doc_len = [], 0
+        for j in range(n):
+            if doc_len >= 4 and rng.random() < 0.3:
+                p = rng.randrange(doc_len - 2)
+                op: object = RemoveOp(pos1=p, pos2=p + 2)
+                doc_len -= 2
+            else:
+                text = "abcdefgh"[:2 + rng.randrange(6)]
+                op = InsertOp(
+                    pos1=rng.randrange(doc_len + 1), text=text)
+                doc_len += len(text)
+            # canonical batchManager marks (first/last) — required
+            # for the batch to be columnar-expressible
+            meta = None
+            if n > 1 and j == 0:
+                meta = mark_batch(None, True)
+            elif n > 1 and j == n - 1:
+                meta = mark_batch(None, False)
+            ops.append(DocumentMessage(
+                client_sequence_number=j + 1,
+                reference_sequence_number=0,
+                type=MessageType.OPERATION,
+                contents=op,
+                metadata=meta,
+            ))
+        cols = encode_columns(ops)
+        assert cols is not None and cols["n"] == n, (
+            "config15 generator left the columnar subset")
+        rows = [document_message_to_json(op) for op in ops]
+        return rows, cols
+
+    def row_pack(rows, seq0: int = 1):
+        stream = DocStream()
+        for j, od in enumerate(rows):
+            dm = document_message_from_json(od)
+            stream.add_message(SequencedMessage(
+                client_id="c0",
+                sequence_number=seq0 + j,
+                minimum_sequence_number=0,
+                client_sequence_number=dm.client_sequence_number,
+                reference_sequence_number=dm.reference_sequence_number,
+                type=dm.type,
+                contents=dm.contents,
+                metadata=dm.metadata,
+            ))
+        return pack_rows(1, {0: stream.ops}), stream.payloads
+
+    def col_pack(cols, seq0: int = 1):
+        validate_columns(cols)
+        block, payloads = lower_columns(cols, seq0=seq0, client=0)
+        return pack_rows(1, {0: block}), payloads
+
+    n_reps = max(2, reps)
+    record: dict = {
+        "total_ops_per_path": total_ops,
+        "batch_sizes": list(sizes),
+        "paths": {},
+    }
+    for n in sizes:
+        rows, cols = make_batch(n, seed=15000 + n)
+        # differential BEFORE timing: both paths must pack the same
+        # window bit-for-bit (client "c0" interns to slot 0, matching
+        # lower_columns' client=0)
+        ra, rp = row_pack(rows)
+        ca, cp = col_pack(cols)
+        assert rp == cp, f"config15 n={n}: payload slices diverge"
+        for f in OP_FIELDS:
+            assert np.array_equal(ra[f], ca[f]), (
+                f"config15 n={n}: packed field {f!r} diverges")
+        iters = max(1, total_ops // n)
+
+        def timed(fn, arg):
+            best = None
+            for _ in range(n_reps):
+                time.sleep(min(cooldown, 0.2))
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    fn(arg)
+                wall = time.perf_counter() - t0
+                best = wall if best is None else min(best, wall)
+            return (iters * n) / best
+
+        row_ops_s = timed(row_pack, rows)
+        col_ops_s = timed(col_pack, cols)
+        record["paths"][str(n)] = {
+            "batches": iters,
+            "row_decode_ops_per_sec": round(row_ops_s, 1),
+            "columnar_ops_per_sec": round(col_ops_s, 1),
+            "columnar_speedup": round(col_ops_s / row_ops_s, 2),
+        }
+    record["parity"] = (
+        "bit-identical packed OP_FIELDS windows + payload slices "
+        f"x{len(sizes)} batch sizes")
+    top = record["paths"][str(sizes[-1])]
+    record["kernel_ops_per_sec"] = top["columnar_ops_per_sec"]
+    if scale != "smoke":
+        # the PR's acceptance criterion, enforced per run
+        assert top["columnar_speedup"] >= 5.0, (
+            "config15: the columnar pack path must be >=5x row "
+            f"decode at batch {sizes[-1]}, got {top}")
     return record
 
 
@@ -2599,6 +2794,7 @@ STAGE_FNS = {
     "config12": stage_config12,
     "config13": stage_config13,
     "config14": stage_config14,
+    "config15": stage_config15,
 }
 
 
@@ -2657,6 +2853,24 @@ def _wire_schema_hash() -> str | None:
         return None
 
 
+def _pack_path() -> str | None:
+    """Which host pack path wire ingress can take in this build —
+    "columnar+rows" when the submitOp registry entry carries the
+    wire-1.3 "cols" field, "rows" otherwise. Rides every stage record
+    next to wire_schema_hash/jax_compiles so a pack-path change
+    surfaces as a BENCH_* delta. None if protocol fails to import
+    (best-effort, like the hash)."""
+    try:
+        from fluidframework_tpu.protocol.constants import (
+            wire_schema_fields,
+        )
+
+        fields = wire_schema_fields("submitOp")
+        return "columnar+rows" if "cols" in fields else "rows"
+    except Exception:  # noqa: BLE001 - the stamp is best-effort
+        return None
+
+
 def _registry_snapshot() -> dict | None:
     """The obs metrics registry, or None if obs failed to import (a
     broken registry must not lose a measured stage)."""
@@ -2706,6 +2920,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         "metrics_registry": _registry_snapshot(),
         "fluidlint_findings": _fluidlint_counts(),
         "wire_schema_hash": _wire_schema_hash(),
+        "pack_path": _pack_path(),
         "jax_compiles": jax_compiles,
     })
     # persist the full-scale result BEFORE the fixed-scale companion:
@@ -2731,6 +2946,7 @@ def run_stage(name: str, backend: str, scale: str, reps: int,
         fixed["metrics_registry"] = _registry_snapshot()
         fixed["fluidlint_findings"] = _fluidlint_counts()
         fixed["wire_schema_hash"] = _wire_schema_hash()
+        fixed["pack_path"] = _pack_path()
         result["fixed_scale"] = fixed
         with open(out_path, "w") as f:
             json.dump(result, f)

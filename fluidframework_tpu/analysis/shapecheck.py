@@ -67,6 +67,10 @@ LADDER_SOURCES = (
     # the event-graph compiler re-buckets its prefix/suffix windows
     # through the BucketLadder internally (same contract as pack_rows)
     ("ops/event_graph.py", "build_event_graph"),
+    # the wire-1.3 columnar slice entry point: its [n, 12] block is
+    # consumed by pack_rows' block fast path, so its column widths
+    # reach the device only through the same BucketLadder bucketing
+    ("ops/host_bridge.py", "lower_columns"),
     ("ops/segment_table.py", "make_table"),
 )
 

@@ -73,6 +73,7 @@ from .core import Finding, SourceFile
 # traffic instead.
 WIRE_MODULES = (
     "protocol/serialization.py",
+    "protocol/columnar.py",
     "drivers/socket_driver.py",
     "drivers/caching_driver.py",
     "service/ingress.py",
@@ -97,6 +98,13 @@ PAYLOAD_CODECS = {
         ("emit", "msg:document"),
     ("service/ingress.py", "document_message_from_json"):
         ("read", "msg:document"),
+    # the wire-1.3 columnar submitOp payload ("cols"): the payload IS
+    # the column layout, so its codec pair registers the column names
+    # the same way the row codecs register message fields
+    ("protocol/columnar.py", "encode_columns"):
+        ("emit", "cols:columnar"),
+    ("protocol/columnar.py", "decode_columns"):
+        ("read", "cols:columnar"),
 }
 
 # request frame type -> the response frame type a ``_request()`` call

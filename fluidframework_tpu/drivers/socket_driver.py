@@ -52,6 +52,7 @@ from ..qos.faults import (
     KIND_REORDER,
     PLANE as _CHAOS,
 )
+from ..protocol.columnar import encode_columns
 from ..service.ingress import document_message_to_json, pack_frame
 
 _LEN = struct.Struct(">I")
@@ -87,8 +88,8 @@ _SITE_FRAME_IN = _CHAOS.site(
 # wire versions this driver speaks, newest first (the server echoes
 # the agreed one in "connected"; see ingress.WIRE_VERSIONS for what
 # each version adds — 1.1 is the chunked summary-upload plane, 1.2 the
-# boxcarred batch submit)
-WIRE_VERSIONS = ("1.2", "1.1", "1.0")
+# boxcarred batch submit, 1.3 the columnar SoA batch submit)
+WIRE_VERSIONS = ("1.3", "1.2", "1.1", "1.0")
 
 
 def build_connect_frame(document_id: str, client_id: str, mode: str,
@@ -623,45 +624,76 @@ class SocketDeltaConnection:
     carries the whole batch array and alfred tickets it atomically;
     this restores that contract. Against a pre-1.2 server the driver
     degrades to per-op frames (the legacy racy behavior, for the
-    compat matrix)."""
+    compat matrix).
+
+    COLUMNAR BATCHES (wire >= 1.3): at the batch flush point, a batch
+    inside the columnar subset (plain text INSERT/REMOVEs, untraced —
+    protocol/columnar.py) is sent as ONE ``submitOp`` frame whose
+    payload IS the column layout ("cols"), which the service validates
+    once and slices instead of re-interpreting per op. Anything the
+    columns cannot express — and any batch against a pre-1.3 server —
+    rides the wire-1.2 row boxcar unchanged (the compatibility
+    fallback the compat matrix pins)."""
 
     def __init__(self, service: SocketDocumentService, client_id: str):
         self._service = service
         self.client_id = client_id
         self.open = True
-        self._batch: list[dict] = []
+        self._batch: list[DocumentMessage] = []
         self._batching = False
 
     def _boxcar_capable(self) -> bool:
         agreed = self._service.agreed_version
         return agreed is not None and not wire_version_lt(agreed, "1.2")
 
+    def _columnar_capable(self) -> bool:
+        agreed = self._service.agreed_version
+        return agreed is not None and not wire_version_lt(agreed, "1.3")
+
     def submit(self, op: DocumentMessage) -> None:
         assert self.open, "submit on closed connection"
         from ..protocol.constants import batch_flag
 
-        # stamped BEFORE serialization so the hop rides the wire (the
-        # boxcar frame carries each member op's traces — wire 1.2 —
-        # and the per-op fallback frame carries them identically)
-        trace_stamp(op.traces, "driver", "send")
-        wire = document_message_to_json(op)
         flag = batch_flag(op.metadata)
         if self._boxcar_capable() and (self._batching or flag is True):
-            self._batch.append(wire)
+            # buffered as the MESSAGE, not its wire form: the flush
+            # point decides the encoding (columnar vs row boxcar) for
+            # the batch as a unit, and the driver:send hop stamps at
+            # the actual wire write below
+            self._batch.append(op)
             self._batching = flag is not False
             if self._batching:
                 return
             ops, self._batch = self._batch, []
+            cols = (encode_columns(ops)
+                    if self._columnar_capable() else None)
+            if cols is not None:
+                # traceless by design: the column layout carries no
+                # traces column, and encode_columns routed any traced
+                # (or otherwise inexpressible) batch to the row path
+                # below — trace_ops traffic keeps its full hop chain
+                self._service._send({
+                    "type": "submitOp",
+                    "document_id": self._service.document_id,
+                    "cols": cols,
+                })
+                return
+            wires = []
+            for o in ops:
+                trace_stamp(o.traces, "driver", "send")
+                wires.append(document_message_to_json(o))
             self._service._send({
                 "type": "submitOp",
                 "document_id": self._service.document_id,
-                "ops": ops,
+                "ops": wires,
             })
             return
+        # stamped BEFORE serialization so the hop rides the wire
+        trace_stamp(op.traces, "driver", "send")
         self._service._send({
             "type": "submitOp",
             "document_id": self._service.document_id,
-            "op": wire,
+            "op": document_message_to_json(op),
         })
 
     def disconnect(self) -> None:

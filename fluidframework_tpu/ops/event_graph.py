@@ -58,13 +58,20 @@ This module is that idea translated to the batched SoA table world:
    lanes instead of a K-step replay loop).
 
 Span breaks (``chunk_start``) happen only where host composition
-stops being exact — the SAME tombstone/min_seq aging conditions the
-chunk compiler uses (an open-span remove aging at/below a later op's
-min_seq; a committed tombstone crossing min_seq before an insert), an
-anchor strictly inside another in-span op's text, or the ``EG_K``
-lane cap. Cross-client visibility — the chunk compiler's main break —
-never breaks a critical span: that is where the throughput comes
-from.
+stops being exact: an anchor strictly inside another in-span op's
+text, the ``EG_K`` lane cap, or the narrow aging-collision residue
+below. The chunk compiler's min_seq-aging breaks are SPLIT instead of
+broken (Eg-walker's internal-run splitting, arXiv 2409.14252): an
+open-span tombstone that ages out of the stop set is split out of the
+anchor walk by the chain itself (``_Chain._locate`` with the
+exclusive ``ms`` watermark), and a committed tombstone crossing
+min_seq mid-span is resolved exactly by the device's per-lane
+``ms_pre`` stop mask — only when an earlier in-span insert shares the
+exact anchor coordinate across the aging boundary does the span still
+break (same-anchor rank groups would split). Absorbed breaks are
+counted per row in ``program["span_splits"]``. Cross-client
+visibility — the chunk compiler's main break — never breaks a
+critical span: that is where the throughput comes from.
 
 Semantics contract: bit-identical live slot state to the sequential
 executor (tests/test_event_graph.py + the three-route sweeps in
@@ -170,17 +177,39 @@ def _graph_arrays(kind, seq, refseq, client, base_head):
     return parent_seq, parent_own, frontier_other, critical
 
 
-def _compile_span_row(out, chunk_start, pred, ev_cover, d: int,
-                      k_max: int) -> None:
+def _compile_span_row(out, chunk_start, pred, ev_cover, span_splits,
+                      d: int, k_max: int) -> None:
     """Compose one document's critical prefix into spans with ONE
     shared chain (the chunk compiler's per-client chain machinery,
     applied span-wide: every op is critical, so every earlier in-span
     op is visible to it and the composition is exact cross-client).
     Rewrites positions into span-base coordinates in place and emits
-    chunk_start/pred/ev_cover. Breaks carry over from the chunk
-    compiler ONLY where they are about tombstone/min_seq aging or
-    composition limits — the cross-client-visibility and refseq-
-    advance breaks vanish by criticality."""
+    chunk_start/pred/ev_cover.
+
+    EVENT SPLITTING (the Eg-walker internal-run split, arXiv
+    2409.14252 §"splitting items", translated to the span chain):
+    where the chunk compiler breaks on min_seq aging, this compiler
+    SPLITS THE EVENT and keeps composing —
+
+    - an OPEN-SPAN remove aging into ``below``: the aged tombstone
+      segment is split out of the anchor walk by ``_Chain._locate``'s
+      ``ms`` threading (the walk passes through it, exactly as the
+      sequential executor's stop mask passes an aged tombstone), so
+      no break is needed at all;
+    - a COMMITTED (pre-span) tombstone aging before an insert: the
+      device's per-lane ``ms_pre`` stop mask resolves the insert's
+      anchor slot exactly, so the span survives UNLESS an earlier
+      in-span insert shares the same anchor base coordinate — only
+      then do the two inserts land in different same-anchor rank
+      groups (pre-aging: the tombstone slot; post-aging: the next
+      live row) and the device cannot replay their relative order, so
+      the span breaks (the narrow residue of the seed-90007 class).
+
+    Every absorbed would-be break counts into ``span_splits[d]`` —
+    the config14 ``span_splits_per_doc`` evidence that the launches
+    saved are real. Breaks that remain: the ``k_max`` lane cap, an
+    anchor strictly inside another in-span op's text, and the
+    same-coordinate aging collision above."""
     kind = out["kind"]
     W = kind.shape[1]
     chain = _Chain(0)
@@ -189,19 +218,29 @@ def _compile_span_row(out, chunk_start, pred, ev_cover, d: int,
     ms_run = 0
     ms_global = 0
     ms_base = 0
+    ms_counted = 0
     rm_committed: list[int] = []   # remove seqs of CLOSED spans
     rm_open: list[int] = []        # remove seqs in the open span
+    ins_coords: set = set()        # base coords of in-span inserts
 
     def fresh(w: int) -> None:
-        nonlocal chain, chunk, base_w, ms_run, ms_base
+        nonlocal chain, chunk, base_w, ms_run, ms_base, ms_counted
         chunk_start[d, w] = 1
         chain = _Chain(0)
         chunk = []
         base_w = w
         ms_run = 0
         ms_base = ms_global
+        ms_counted = ms_global
         rm_committed.extend(rm_open)  # stays seq-sorted: stream order
         rm_open.clear()
+        ins_coords.clear()
+
+    def committed_aged(lo: int) -> bool:
+        """Did min_seq cross a committed remove's seq since ``lo``?"""
+        return ms_global > lo and \
+            bisect_right(rm_committed, ms_global) > \
+            bisect_right(rm_committed, lo)
 
     fresh(0)
     for w in range(W):
@@ -218,37 +257,52 @@ def _compile_span_row(out, chunk_start, pred, ev_cover, d: int,
         def must_break() -> bool:
             if len(chunk) >= k_max:
                 return True
-            # committed-tombstone aging before an insert: min_seq
-            # crossed a pre-span remove's seq since the span opened,
-            # so this insert's stop-slot eligibility differs from
-            # earlier in-span events' (the seed-90007 class — same
-            # condition as the chunk compiler's)
-            if kd == KIND_INSERT and ms_global > ms_base and \
-                    bisect_right(rm_committed, ms_global) > \
-                    bisect_right(rm_committed, ms_base):
-                return True
-            # an open-span remove aging into `below`: the sequential
-            # executor would exclude its slots from stop for this op,
-            # which the span-base view cannot see (rm_open ascends in
-            # stream order, so the head is the oldest)
-            if rm_open and rm_open[0] <= ms_k:
-                return True
+            # the aging-collision residue: a committed tombstone
+            # crossed min_seq since the span opened AND an earlier
+            # in-span insert anchors at the very coordinate this
+            # insert would map to — their same-anchor rank groups
+            # split across the aged tombstone, which the device
+            # cannot order (probe is non-mutating; ms_run is the
+            # exclusive watermark, matching the device's ms_pre)
+            if kd == KIND_INSERT and committed_aged(ms_base):
+                probe = chain._locate(
+                    int(out["pos1"][d, w]), ms_run)[2]
+                if probe in ins_coords:
+                    return True
             return False
 
         if must_break():
             fresh(w)
+        else:
+            # count the span breaks event-splitting absorbed (each
+            # would have been a fresh() under the chunk compiler's
+            # aging conditions): an open-span tombstone aging out of
+            # the anchor walk, or a committed tombstone crossing
+            # min_seq before an insert without a coordinate collision
+            if rm_open and rm_open[0] <= ms_k:
+                span_splits[d] += 1
+                while rm_open and rm_open[0] <= ms_k:
+                    rm_committed.append(rm_open.pop(0))
+                # one aging event = one absorbed break: the seqs just
+                # moved must not re-count through the insert-crossing
+                # branch below
+                ms_counted = max(ms_counted, ms_k)
+            if kd == KIND_INSERT and committed_aged(ms_counted):
+                span_splits[d] += 1
+                ms_counted = ms_global
         if kd == KIND_INSERT:
             b, pr, ok = chain.map_insert(
                 int(out["pos1"][d, w]),
-                int(out["length"][d, w]), w - base_w)
+                int(out["length"][d, w]), w - base_w, ms_run)
             if not ok:
                 fresh(w)
                 b, pr, ok = chain.map_insert(
                     int(out["pos1"][d, w]),
-                    int(out["length"][d, w]), 0)
+                    int(out["length"][d, w]), 0, ms_run)
                 assert ok
             out["pos1"][d, w] = b
             pred[d, w] = pr
+            ins_coords.add(b)
         else:
             p1 = int(out["pos1"][d, w])
             p2 = int(out["pos2"][d, w])
@@ -261,7 +315,7 @@ def _compile_span_row(out, chunk_start, pred, ev_cover, d: int,
             out["pos2"][d, w] = b2
             ev_cover[d, w] = cover
             if kd == KIND_REMOVE:
-                chain.apply_remove(p1, p2)
+                chain.apply_remove(p1, p2, int(out["seq"][d, w]))
                 rm_open.append(int(out["seq"][d, w]))
         chunk.append(w)
         ms_run = ms_k
@@ -302,8 +356,13 @@ def build_event_graph(arrays: dict, base_head=None, k_max: int = EG_K,
                        critical.astype(np.int32), prefix_len)
     ladder = BucketLadder(window_floor=window_floor)
 
+    # per-row count of would-be span breaks event-splitting absorbed
+    # (feeds egwalker_span_splits_total and config14's
+    # span_splits_per_doc — the launches-saved evidence)
+    span_splits = np.zeros(D, np.int32)
     program: dict = {"egwalker": True, "k": k_max, "graph": graph,
-                     "prefix": None, "suffix": None}
+                     "prefix": None, "suffix": None,
+                     "span_splits": span_splits}
     max_p = int(prefix_len.max()) if D else 0
     if max_p > 0:
         P = ladder.window_bucket(max_p)
@@ -325,7 +384,7 @@ def build_event_graph(arrays: dict, base_head=None, k_max: int = EG_K,
         chunk_start[~has_real, ::k_max] = 1
         for d in np.flatnonzero(has_real):
             _compile_span_row(pref, chunk_start, pred, ev_cover,
-                              int(d), k_max)
+                              span_splits, int(d), k_max)
         pref["chunk_start"] = chunk_start
         pref["pred"] = pred
         pref["ev_cover"] = ev_cover
@@ -393,17 +452,31 @@ def _walker_step(st: dict, ops: dict, K: int):
     # removals visible => vis = alive & ~removed, identical across
     # lanes. One [D, C] pass + one cumsum replaces the chunked
     # executor's per-lane [D, K, C] view stack. `stop` (insert
-    # tie-break eligibility) uses the span-base min_seq: the span
-    # compiler breaks wherever a tombstone's below-status could change
-    # a resolution mid-span, so ms0 is exact for every lane.
+    # tie-break eligibility) is the ONLY lane-dependent mask: a lane's
+    # `below` watermark is the exclusive running max of earlier taken
+    # lanes' min_seq (the chunked step's ms_pre cummax — the
+    # sequential executor applies an op's min_seq AFTER its view
+    # pass), so a committed tombstone aging MID-SPAN resolves exactly
+    # instead of forcing a span break (the event-splitting win). The
+    # mask stays a 1-byte bool [D, K, C]; E/vis stay shared [D, C].
     j = lax.broadcasted_iota(jnp.int32, (D, C), 1)
     count = st["count"][:, None]                           # [D,1]
     alive = j < count
     removed = st["removed_seq"] != NOT_REMOVED
     ms0 = st["min_seq"][:, None]
-    below = removed & (st["removed_seq"] <= ms0)
+    inc_ms = lax.cummax(
+        jnp.where(taken, ops["min_seq"], 0), axis=1
+    )
+    ms_pre = jnp.maximum(
+        ms0, jnp.concatenate(
+            [jnp.zeros((D, 1), jnp.int32), inc_ms[:, :-1]], axis=1
+        )
+    )                                                      # [D,K]
+    below_lane = removed[:, None, :] & (
+        st["removed_seq"][:, None, :] <= ms_pre[..., None]
+    )                                                      # [D,K,C]
     vis = alive & ~removed
-    stop = alive & ~below
+    stop3 = alive[:, None, :] & ~below_lane
     vlen = jnp.where(vis, st["length"], 0)                 # [D,C]
     E = jnp.cumsum(vlen, axis=-1) - vlen
     incl = E + vlen
@@ -419,7 +492,6 @@ def _walker_step(st: dict, ops: dict, K: int):
     # the wide intermediates stay 1-byte bools.
     E3 = E[:, None, :]                                     # [D,1,C]
     incl3 = incl[:, None, :]
-    stop3 = stop[:, None, :]
     p1 = ops["pos1"][..., None]                            # [D,K,1]
     p2 = ops["pos2"][..., None]
 

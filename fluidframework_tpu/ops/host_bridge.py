@@ -253,6 +253,54 @@ def coalesce_noops(ops: list[dict]) -> list[dict]:
     return out
 
 
+def lower_columns(cols: dict, *, seq0: int, client: int,
+                  min_seq=0) -> tuple[np.ndarray, list[str]]:
+    """Vectorized lowering of a VALIDATED columnar batch
+    (``protocol.columnar.validate_columns`` first — this function
+    slices, it does not re-check) into one ``[n, len(OP_FIELDS)]``
+    int32 row block plus its payload slices — the zero-per-op twin of
+    ``DocStream._add_op`` for the columnar subset (plain INSERT /
+    REMOVE from one client, contiguous seqs ``seq0..seq0+n-1``, the
+    shape an atomically-ticketed batch sequences as). The block's
+    column order IS ``OP_FIELDS``; ``pack_rows`` accepts such blocks
+    directly and degrades to array concatenation. ``min_seq`` may be
+    a scalar or a per-op array; ``op_id`` is LOCAL (0-based per
+    insert) — callers appending to an existing stream offset it by
+    their payload count."""
+    n = cols["n"]
+    kind = np.asarray(cols["kind"], np.int32)
+    off = np.asarray(cols["text_off"], np.int64)
+    length = (off[1:] - off[:-1]).astype(np.int32)
+    if int(length.max(initial=0)) >= OPOFF_BOUND:
+        # parity with DocStream._add_op: one op's payload bounds the
+        # op_off composite the kernel's fused reduce packs
+        raise ValueError(
+            f"insert payload {int(length.max())} exceeds device "
+            f"bound {OPOFF_BOUND}"
+        )
+    is_ins = kind == KIND_INSERT
+    block = np.zeros((n, len(OP_FIELDS)), np.int32)
+    block[:, OP_FIELDS.index("kind")] = kind
+    block[:, OP_FIELDS.index("pos1")] = cols["pos1"]
+    block[:, OP_FIELDS.index("pos2")] = cols["pos2"]
+    block[:, OP_FIELDS.index("seq")] = seq0 + np.arange(
+        n, dtype=np.int32)
+    block[:, OP_FIELDS.index("refseq")] = cols["refseq"]
+    block[:, OP_FIELDS.index("client")] = client
+    # inserts number their payloads in batch order (cumsum is the
+    # vectorized running len(payloads))
+    block[:, OP_FIELDS.index("op_id")] = np.where(
+        is_ins, np.cumsum(is_ins) - 1, 0
+    ).astype(np.int32)
+    block[:, OP_FIELDS.index("length")] = np.where(is_ins, length, 0)
+    block[:, OP_FIELDS.index("min_seq")] = min_seq
+    text = cols["text"]
+    payloads = [
+        text[off[i]:off[i + 1]] for i in range(n) if is_ins[i]
+    ]
+    return block, payloads
+
+
 def pack_rows(n_rows: int, ops_by_row: dict,
               bucket_floor: int = 16) -> dict:
     """Pack per-row op lists into padded [n_rows, bucket] arrays with
@@ -267,7 +315,13 @@ def pack_rows(n_rows: int, ops_by_row: dict,
     Vectorized: one fromiter pass builds a [total_ops, n_fields]
     matrix, then one fancy-index scatter per field lands it — no
     per-op per-field Python loop (the old quadratic-ish host cost on
-    the serving path)."""
+    the serving path).
+
+    COLUMNAR FAST PATH: a row's value may be a ``[k, len(OP_FIELDS)]``
+    int32 block (``lower_columns``) instead of a list of op dicts —
+    then this degrades to array concatenation with zero per-op Python,
+    which is the whole point of the wire-1.3 columnar ingress (bench
+    config15 measures the two paths side by side)."""
     from .bucket_ladder import BucketLadder
 
     window = max((len(v) for v in ops_by_row.values()), default=0)
@@ -275,7 +329,8 @@ def pack_rows(n_rows: int, ops_by_row: dict,
     arrays = {f: np.zeros((n_rows, bucket), np.int32)
               for f in OP_FIELDS}
     arrays["kind"][:] = KIND_NOOP
-    items = [(row, ops) for row, ops in ops_by_row.items() if ops]
+    items = [(row, ops) for row, ops in ops_by_row.items()
+             if len(ops)]
     if not items:
         return arrays
     lens = np.array([len(ops) for _, ops in items], np.int64)
@@ -284,10 +339,26 @@ def pack_rows(n_rows: int, ops_by_row: dict,
     starts = np.cumsum(lens) - lens
     col_idx = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
     n_fields = len(OP_FIELDS)
-    flat = np.fromiter(
-        (op[f] for _, ops in items for op in ops for f in OP_FIELDS),
-        np.int32, count=total * n_fields,
-    ).reshape(total, n_fields)
+    if any(isinstance(ops, np.ndarray) for _, ops in items):
+        blocks = []
+        for _, ops in items:
+            if isinstance(ops, np.ndarray):
+                assert ops.ndim == 2 and ops.shape[1] == n_fields, \
+                    f"columnar block must be [k, {n_fields}]"
+                blocks.append(ops.astype(np.int32, copy=False))
+            else:
+                blocks.append(np.fromiter(
+                    (op[f] for op in ops for f in OP_FIELDS),
+                    np.int32, count=len(ops) * n_fields,
+                ).reshape(len(ops), n_fields))
+        flat = (np.concatenate(blocks, axis=0)
+                if len(blocks) > 1 else blocks[0])
+    else:
+        flat = np.fromiter(
+            (op[f] for _, ops in items for op in ops
+             for f in OP_FIELDS),
+            np.int32, count=total * n_fields,
+        ).reshape(total, n_fields)
     dst = row_idx * bucket + col_idx
     for j, f in enumerate(OP_FIELDS):
         arrays[f].reshape(-1)[dst] = flat[:, j]

@@ -95,14 +95,18 @@ class _Seg:
     resolves against — own-removed base text keeps counting there
     until the chunk materializes); ``view_len`` is its width in the
     client's CURRENT own view; ``ev_k`` >= 0 marks own in-chunk insert
-    text (zero base width)."""
+    text (zero base width); ``rm_seq`` records the sequence number of
+    the in-chunk remove that zeroed this segment's view (None = never
+    removed) — the event-splitting walkers use it to age tombstone
+    segments out of the anchor walk (``_locate`` with ``ms``)."""
 
-    __slots__ = ("base_len", "view_len", "ev_k")
+    __slots__ = ("base_len", "view_len", "ev_k", "rm_seq")
 
     def __init__(self, base_len, view_len, ev_k=-1):
         self.base_len = base_len
         self.view_len = view_len
         self.ev_k = ev_k
+        self.rm_seq = None
 
 
 class _Chain:
@@ -112,26 +116,38 @@ class _Chain:
         self.refseq = refseq
         self.segs: list[_Seg] = []  # implicit infinite base tail after
 
-    def _locate(self, pos: int):
+    def _locate(self, pos: int, ms=None):
         """Own-view pos -> (seg index, offset, base coord). The walk
         stops at the FIRST zero-view segment once the position is
         consumed (a sequenced insert tie-breaks BEFORE zero-width
         slots at its point — breakTie, seq > slot seq always on the
-        sequenced path). Index len(segs) = the infinite base tail."""
+        sequenced path) — UNLESS ``ms`` is given and the segment is an
+        in-chunk tombstone whose remove has aged at/below it
+        (``rm_seq <= ms``): an aged tombstone leaves the stop set
+        (fused_step's ``below`` mask), so the walk passes THROUGH it —
+        this is the event split that lets the egwalker span survive
+        min_seq aging. Index len(segs) = the infinite base tail."""
         base = 0
         rem = pos
         for i, s in enumerate(self.segs):
             if rem < s.view_len or (rem == 0 and s.view_len == 0):
+                if ms is not None and s.view_len == 0 \
+                        and s.rm_seq is not None and s.rm_seq <= ms:
+                    base += s.base_len
+                    continue
                 return i, rem, base + (rem if s.ev_k < 0 else 0)
             rem -= s.view_len
             base += s.base_len
         return len(self.segs), rem, base + rem
 
-    def map_insert(self, pos: int, length: int, k: int):
+    def map_insert(self, pos: int, length: int, k: int, ms=None):
         """Place own insert at own-view ``pos``. Returns
         (base_coord, pred, ok); ok False => the anchor falls strictly
-        inside own event text (chunk must break)."""
-        i, off, base = self._locate(pos)
+        inside own event text (chunk must break). ``ms`` (the
+        EXCLUSIVE min_seq watermark for this op — before its own
+        min_seq applies, matching the device's ``ms_pre`` cummax)
+        ages in-chunk tombstone segments out of the anchor walk."""
+        i, off, base = self._locate(pos, ms)
         if off > 0:
             if i < len(self.segs):
                 seg = self.segs[i]
@@ -181,9 +197,11 @@ class _Chain:
             i += 1
         return b1, b2, cover, True
 
-    def apply_remove(self, p1: int, p2: int) -> None:
+    def apply_remove(self, p1: int, p2: int, seq=None) -> None:
         """Materialize own remove in the own view (base widths stay —
-        the device counts the text until the chunk materializes)."""
+        the device counts the text until the chunk materializes).
+        ``seq`` stamps the zeroed segments' ``rm_seq`` so a later
+        ``_locate(..., ms)`` can age them out of the anchor walk."""
         for p in (p2, p1):  # split p2 first so indices stay valid
             i, off, _ = self._locate(p)
             if off > 0 and i < len(self.segs):
@@ -204,6 +222,8 @@ class _Chain:
                 if off == 0:
                     rem -= s.view_len if s.view_len <= rem else rem
                     s.view_len = max(0, s.view_len - take)
+                    if s.view_len == 0:
+                        s.rm_seq = seq
                 else:  # pragma: no cover - boundaries were split
                     rem -= take
             off = 0

@@ -86,6 +86,10 @@ WIRE_SCHEMA = {
         "op": "1.0",
         # boxcar member list (wire 1.2); mutually exclusive with "op"
         "ops": "1.2?",
+        # columnar SoA batch (wire 1.3, protocol/columnar.py); the
+        # payload IS the column layout — see the cols:columnar
+        # pseudo-type. Mutually exclusive with "op"/"ops".
+        "cols": "1.3?",
     },
     "op": {
         "document_id": "1.0",
@@ -194,6 +198,19 @@ WIRE_SCHEMA = {
         "contents": "1.0",
         "metadata": "1.0",
         "traces": "1.0",
+    },
+    # the columnar submitOp payload (the dict riding "cols"; wire 1.3,
+    # protocol/columnar.py is the one codec). Parallel arrays: every
+    # column is length n (text_off: n+1 monotone offsets into text).
+    "cols:columnar": {
+        "n": "1.3",
+        "csn": "1.3",
+        "refseq": "1.3",
+        "kind": "1.3",
+        "pos1": "1.3",
+        "pos2": "1.3",
+        "text_off": "1.3",
+        "text": "1.3",
     },
 }
 

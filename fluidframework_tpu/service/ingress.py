@@ -47,6 +47,7 @@ from typing import Any, Optional
 
 from ..obs import metrics as obs_metrics
 from ..obs.trace import stamp as trace_stamp
+from ..protocol.columnar import decode_columns, validate_columns
 from ..protocol.constants import wire_version_lt
 from ..qos import CLASS_CATCHUP, CLASS_SUMMARY, CLASS_WRITE
 from ..qos.faults import KIND_ERROR, PLANE as _CHAOS
@@ -94,6 +95,9 @@ _OPS_TICKETED = obs_metrics.REGISTRY.counter(
     "served)")
 _BOXCARS = obs_metrics.REGISTRY.counter(
     "ingress_boxcars_total", "wire-1.2 boxcarred batch submits")
+_COLUMNAR = obs_metrics.REGISTRY.counter(
+    "ingress_columnar_batches_total",
+    "wire-1.3 columnar SoA batch submits (validated once, sliced)")
 _NACKS_OUT = obs_metrics.REGISTRY.counter(
     "ingress_nacks_sent_total", "nack frames sent to clients")
 _ERRORS_OUT = obs_metrics.REGISTRY.counter(
@@ -141,7 +145,12 @@ _SITE_UPLOAD = _CHAOS.site("ingress.summary_upload", (KIND_ERROR,))
 #       atomically on the event loop, so a runtime batch can never be
 #       interleaved with another session's ops in the sequenced order
 #       (the submit->ack liveness fix — see SocketDeltaConnection).
-WIRE_VERSIONS = ("1.2", "1.1", "1.0")
+# 1.3 — adds the columnar SoA batch submit: one submitOp frame may
+#       carry "cols": {parallel arrays + shared payload string}
+#       (protocol/columnar.py) — validated once, sliced, never
+#       re-interpreted per op. Same atomic-ticket semantics as the
+#       1.2 boxcar; 1.0-1.2 peers keep the row paths unchanged.
+WIRE_VERSIONS = ("1.3", "1.2", "1.1", "1.0")
 
 
 def document_message_to_json(op: DocumentMessage) -> dict:
@@ -808,28 +817,71 @@ class AlfredServer:
                     f"(connection agreed "
                     f"{session.wire_versions.get(doc, '1.0')})"
                 )
-            ops_json = boxcar if boxcar is not None else [frame["op"]]
-            if boxcar is not None:
-                _BOXCARS.inc()
+            cols = frame.get("cols")
+            if cols is not None:
+                # "cols" (wire >= 1.3) = one columnar SoA batch
+                # (protocol/columnar.py). Same atomic-ticket shape as
+                # the boxcar; the column layout is interpreted exactly
+                # ONCE, below, never per op.
+                if boxcar is not None or frame.get("op") is not None:
+                    raise ValueError(
+                        "submitOp carries exactly one of op/ops/cols"
+                    )
+                if wire_version_lt(
+                        session.wire_versions.get(doc, "1.0"), "1.3"):
+                    raise ValueError(
+                        "columnar submit requires wire version >= 1.3 "
+                        f"(connection agreed "
+                        f"{session.wire_versions.get(doc, '1.0')})"
+                    )
+                # the whole column layout is validated BEFORE anything
+                # slices it; a malformed column refuses the batch as a
+                # unit with a BAD_REQUEST nack — nothing sequenced,
+                # nothing sliced
+                try:
+                    n_ops = validate_columns(cols)
+                except ValueError as e:
+                    _NACKS_OUT.inc()
+                    session.send({
+                        "type": "nack", "document_id": doc,
+                        "operation": None,
+                        "sequence_number": 0,
+                        "error_type": int(NackErrorType.BAD_REQUEST),
+                        "message": str(e),
+                    })
+                    return
+                _COLUMNAR.inc()
+                ops_json = None
+                # columnar batches are writes by construction: the
+                # column vocabulary is INSERT/REMOVE only, so no
+                # summarize proposal can ride one
+                klass = CLASS_WRITE
+            else:
+                ops_json = boxcar if boxcar is not None \
+                    else [frame["op"]]
+                if boxcar is not None:
+                    _BOXCARS.inc()
+                # Summarize proposals classify as summary traffic
+                # (first to shed). ALL-summarize only: the client's
+                # summarizer submits solo frames, so this is the legit
+                # shape — a mixed batch must classify as write, or
+                # co-batching one SUMMARIZE would shed writer ops at
+                # ELEVATED and dodge the op/byte budgets (charging the
+                # summary buckets instead)
+                klass = CLASS_SUMMARY if ops_json and all(
+                    o.get("type") == int(MessageType.SUMMARIZE)
+                    for o in ops_json
+                ) else CLASS_WRITE
+                n_ops = len(ops_json)
             # the admission gate sits BEFORE decode: at 10x offered
             # load, the shed path must cost a dict lookup and a
-            # bucket peek, not a full op decode. Summarize proposals
-            # classify as summary traffic (first to shed).
-            # ALL-summarize only: the client's summarizer submits
-            # solo frames, so this is the legit shape — a mixed batch
-            # must classify as write, or co-batching one SUMMARIZE
-            # would shed writer ops at ELEVATED and dodge the
-            # op/byte budgets (charging the summary buckets instead)
-            klass = CLASS_SUMMARY if ops_json and all(
-                o.get("type") == int(MessageType.SUMMARIZE)
-                for o in ops_json
-            ) else CLASS_WRITE
-            # offered counts BEFORE the gate: the goodput SLO's
-            # denominator must include what admission shed, or the
-            # objective could never see an overload
-            _OPS_OFFERED.inc(len(ops_json))
+            # bucket peek, not a full op decode. Offered counts
+            # BEFORE the gate: the goodput SLO's denominator must
+            # include what admission shed, or the objective could
+            # never see an overload
+            _OPS_OFFERED.inc(n_ops)
             adm = self._admit(session, klass, doc, frame,
-                              ops=len(ops_json), nbytes=nbytes)
+                              ops=n_ops, nbytes=nbytes)
             if adm is not None:
                 self._send_shed(session, doc, frame, adm,
                                 as_nack=True)
@@ -838,13 +890,22 @@ class AlfredServer:
             # malformed op mid-boxcar must fail the batch as a unit
             # (error frame, nothing sequenced) — partially ticketing
             # it would put a torn batch on the wire, the exact state
-            # the boxcar protocol exists to rule out
-            decoded = [document_message_from_json(o) for o in ops_json]
+            # the boxcar protocol exists to rule out. The columnar
+            # batch was already validated as a unit above; this is
+            # its one column->message slicing pass, at the sequencer
+            # boundary (single-sourced sequencing: interpreted once).
+            decoded = decode_columns(cols) if cols is not None \
+                else [document_message_from_json(o) for o in ops_json]
             _OPS_IN.inc(len(decoded))
             for op in decoded:
                 # the front-door hop: client-side stamps arrived on
                 # the frame; this marks event-loop receipt
                 trace_stamp(op.traces, "ingress", "receive")
+            if ops_json is None:
+                # columnar: the nack echo below reconstructs the row
+                # form lazily (rejections only — the served path never
+                # pays a per-op re-encode)
+                ops_json = [None] * len(decoded)
             for op_json, op in zip(ops_json, decoded):
                 try:
                     conn.submit(op)
@@ -859,7 +920,10 @@ class AlfredServer:
                     _NACKS_OUT.inc()
                     session.send({
                         "type": "nack", "document_id": doc,
-                        "operation": op_json,
+                        "operation": (
+                            op_json if op_json is not None
+                            else document_message_to_json(op)
+                        ),
                         "sequence_number": 0,
                         "error_type": int(NackErrorType.INVALID_SCOPE),
                         "message": str(e),

@@ -148,6 +148,10 @@ _M_DUP_DROPS = obs_metrics.REGISTRY.counter(
     "sidecar_duplicate_drops_total",
     "already-ingested sequenced messages dropped by the per-document "
     "sequence-number check (at-least-once delivery upstream)")
+_M_SPAN_SPLITS = obs_metrics.REGISTRY.counter(
+    "egwalker_span_splits_total",
+    "would-be span breaks the egwalker compiler absorbed by event "
+    "splitting (each one is a saved walker launch)")
 
 # chaos seams (docs/ROBUSTNESS.md): the dispatch site fires BEFORE the
 # round mutates anything (queues intact, so a retry is exact); the
@@ -161,22 +165,64 @@ _SITE_POOL_ADMIT = _CHAOS.site("sidecar.pool_admit", (KIND_ERROR,))
 _SITE_POOL_MIGRATE = _CHAOS.site("sidecar.pool_migrate", (KIND_DEFER,))
 
 
+# --- the TPU default's launch arithmetic (reviewed, not hard-coded) --
+#
+# On the launch-taxed axon tunnel the serving cost model is
+# launches/window x cost/launch: every kernel launch pays a ~0.3 ms
+# tunnel round-trip (TPU_EVIDENCE round 3; the per-launch cost slots
+# in from real-chip timings when the tunnel returns), so the route
+# with the fewest launches per dispatch window wins regardless of
+# per-step FLOPs. The launches/window column is RECORDED evidence —
+# bench config14's sequential-heavy corpus at cpu scale (BENCH PR15:
+# walker spans 14.5 vs chunked chunks 53.4 per doc window; the scan
+# route pays one fused step per op = the padded 64-op window at that
+# scale). Launch COUNTS are backend-portable (they are compiled-
+# program dispatch counts, not timings), which is what lets CPU
+# evidence drive the TPU default before real-chip numbers land.
+# ``default_executor`` derives the TPU route from this table and
+# bench config14 stamps the table + decision into its record, so a
+# re-measure that changes the winner changes the default in review.
+LAUNCH_COST_MS = 0.3
+LAUNCHES_PER_WINDOW = {
+    "scan": 64.0,       # one fused step per op in the padded window
+    "chunked": 53.4,    # chunked_chunks_per_doc, config14 sequential
+    "egwalker": 14.5,   # walker_spans_per_doc, config14 sequential
+                        # (pre-event-splitting; splitting only shrinks
+                        # it, so the flip is conservative)
+}
+
+
+def executor_flip() -> dict:
+    """The launch-arithmetic decision behind the TPU default, with
+    its inputs: per-route modeled launch cost per dispatch window and
+    the winning route. Stamped into bench config14's record so the
+    flip is reviewable data, not a constant."""
+    cost = {
+        route: round(n * LAUNCH_COST_MS, 2)
+        for route, n in LAUNCHES_PER_WINDOW.items()
+    }
+    return {
+        "launch_cost_ms": LAUNCH_COST_MS,
+        "launches_per_window": dict(LAUNCHES_PER_WINDOW),
+        "launch_ms_per_window": cost,
+        "winner": min(cost, key=cost.get),
+        "evidence": "config14 sequential-heavy graph stats (cpu "
+                    "scale); ~0.3ms/launch tunnel model",
+    }
+
+
 def default_executor() -> str:
-    """Service-side executor route. On a TPU backend the chunked
-    macro-step executor is the default: launch overhead (~0.3 ms each
-    through the axon tunnel) and HBM traffic amortize over K ops per
-    step, which is where the serving win lives. On backends without a
-    launch tax (CPU) the one-op-per-step scan stays the default — the
-    macro-step's [D, C+3K, K] resolve + sort costs 4-5x a fused scan
-    step there and launches are ~free, so routing chunked would be a
-    measured serving REGRESSION (bench config7 records both routes
-    per backend). The THIRD route, ``egwalker`` (ops/event_graph.py:
-    critical-version fast path over the concurrent-op event graph),
-    is explicitly routed for now — bench config14 records where it
-    wins per corpus (4-6x over chunked on sequential-heavy CPU
-    traffic; ~4x fewer kernel launches per window than either route,
-    the number that matters on the launch-taxed tunnel) — and
-    becomes a backend default only once real-chip numbers land.
+    """Service-side executor route. On a TPU backend the default is
+    DERIVED from the launch-arithmetic table above (currently the
+    egwalker route: 14.5 modeled launches/window vs chunked's 53.4 —
+    the critical-version fast path composes whole spans per launch,
+    and event splitting keeps spans open across min_seq-aging
+    boundaries). On backends without a launch tax (CPU) the
+    one-op-per-step scan stays the default — the macro-step routes'
+    [D, ..., K] resolve + sort costs several x a fused scan step
+    there and launches are ~free, so routing by the table would be a
+    measured serving REGRESSION (bench config7/config14 record
+    per-route numbers per backend).
     ``FFTPU_SIDECAR_EXECUTOR=scan|chunked|egwalker`` overrides either
     way (the operational escape hatch)."""
     env = os.environ.get("FFTPU_SIDECAR_EXECUTOR")
@@ -192,7 +238,7 @@ def default_executor() -> str:
         backend = jax.default_backend()
     except RuntimeError:  # pragma: no cover - backend init failure
         backend = "cpu"
-    return "chunked" if backend == "tpu" else "scan"
+    return executor_flip()["winner"] if backend == "tpu" else "scan"
 
 
 class SeqShardedPool:
@@ -984,6 +1030,10 @@ class TpuMergeSidecar:
         program = self._compile_program(
             arrays, base_head=self._slot_head
         )
+        if program.get("egwalker") and "span_splits" in program:
+            # host-side scalar (the compiler counts absorbed breaks on
+            # the way down); no device read
+            _M_SPAN_SPLITS.inc(int(program["span_splits"].sum()))
         if self.executor == "egwalker":
             # advance the applied-head watermarks AFTER compiling: the
             # program's criticality was judged against the pre-window

@@ -60,12 +60,17 @@ _TRIPS_TOTAL = obs_metrics.REGISTRY.counter(
 # frame keys whose values are op payloads: key -> (pseudo-type,
 # is-list). A non-dict payload (None nack operation, an already
 # opaque blob) is counted for the FRAME field but not descended into.
+# "cols" is the wire-1.3 columnar submitOp payload: the dict IS the
+# column layout (protocol/columnar.py), so the descent records its
+# column names against the cols:columnar pseudo-type exactly like
+# the row payloads record against msg:*.
 _PAYLOAD_KEYS = {
     "msg": ("msg:sequenced", False),
     "msgs": ("msg:sequenced", True),
     "op": ("msg:document", False),
     "ops": ("msg:document", True),
     "operation": ("msg:document", False),
+    "cols": ("cols:columnar", False),
 }
 
 

@@ -244,11 +244,13 @@ def test_anchor_inside_foreign_event_text_breaks_the_span():
     )
 
 
-def test_open_span_remove_aging_breaks_the_span():
+def test_open_span_remove_aging_splits_the_event():
     """An in-span remove whose seq falls at/below a later op's
-    min_seq ages into `below` mid-span — the shared-stop fast path
-    cannot see that, so the compiler must break (the chunk compiler's
-    condition (a))."""
+    min_seq ages into `below` mid-span. Event splitting absorbs what
+    used to be a mandatory span break: the chain splits the aged
+    tombstone segment out of the anchor walk (``_locate`` with the
+    exclusive ms watermark) and the span keeps composing — the
+    absorbed break is counted in ``span_splits``."""
     rows = [
         dict(kind=KIND_INSERT, pos1=0, seq=1, refseq=0, client=0,
              op_id=0, length=3),
@@ -259,7 +261,10 @@ def test_open_span_remove_aging_breaks_the_span():
     ]
     batch = _raw(rows)
     program = build_event_graph(_arrays(batch))
-    assert program["prefix"]["chunk_start"][0, 2] == 1
+    # the aging boundary no longer breaks the span (only the
+    # anchor-inside-event break at w=1 remains)
+    assert program["prefix"]["chunk_start"][0, 2] == 0
+    assert program["span_splits"][0] == 1
     assert_live_equal(
         apply_window_impl(make_table(1, 64), batch),
         apply_batch_egwalker(make_table(1, 64), batch),
@@ -267,11 +272,38 @@ def test_open_span_remove_aging_breaks_the_span():
     )
 
 
-def test_committed_tombstone_aging_breaks_before_an_insert():
-    """The seed-90007 class carried over: a PRE-span tombstone whose
-    below-status flips mid-span splits a same-position rank group —
-    the compiler closes the span at the second insert (the chunk
-    compiler's condition (b))."""
+def test_aged_tombstone_anchor_passes_through():
+    """The split's SEMANTIC half: an insert AT an aged tombstone's
+    coordinate must land past it (the sequential stop mask passes an
+    aged tombstone), while an insert before aging stops at it — both
+    composed inside one surviving span where possible."""
+    rows = [
+        dict(kind=KIND_INSERT, pos1=0, seq=1, refseq=0, client=0,
+             op_id=0, length=4),
+        dict(kind=KIND_REMOVE, pos1=1, pos2=3, seq=2, refseq=1,
+             client=1),
+        # min_seq crosses the remove, then an insert maps exactly to
+        # the tombstone's view coordinate
+        dict(kind=KIND_NOOP, min_seq=2),
+        dict(kind=KIND_INSERT, pos1=1, seq=3, refseq=2, client=2,
+             op_id=1, length=2),
+    ]
+    batch = _raw(rows)
+    program = build_event_graph(_arrays(batch))
+    assert program["span_splits"][0] == 1
+    assert_live_equal(
+        apply_window_impl(make_table(1, 64), batch),
+        apply_batch_egwalker(make_table(1, 64), batch),
+        "aged anchor pass-through",
+    )
+
+
+def test_committed_tombstone_aging_collision_still_breaks():
+    """The seed-90007 residue: a committed tombstone's below-status
+    flips mid-span AND two same-coordinate inserts straddle the flip
+    — their same-anchor rank groups would split across the aged
+    tombstone, so the compiler still closes the span at the second
+    insert (the narrow break event splitting cannot absorb)."""
     rows = [
         dict(kind=KIND_INSERT, pos1=0, seq=1, refseq=0, client=0,
              op_id=0, length=2),
@@ -283,11 +315,42 @@ def test_committed_tombstone_aging_breaks_before_an_insert():
              op_id=2, length=1),
     ]
     batch = _raw(rows)
+    program = build_event_graph(_arrays(batch))
+    assert program["prefix"]["chunk_start"][0, 3] == 1
     seq_tab = apply_window_impl(make_table(1, 64), batch)
     eg_tab = apply_batch_egwalker(make_table(1, 64), batch)
     assert_live_equal(seq_tab, eg_tab, "committed aging")
     seqs = np.asarray(seq_tab.seq)[0, :4].tolist()
     assert seqs == [1, 4, 3, 1], seqs
+
+
+def test_remove_heavy_sequential_spans_shrink():
+    """The config14 remove-heavy claim in miniature: a typing burst
+    interleaved with aging removes used to break at every aging
+    boundary; with event splitting the span count drops to the
+    k_max ceiling and every absorbed boundary is counted."""
+    rows = []
+    seq = 0
+    for i in range(6):
+        seq += 1
+        rows.append(dict(kind=KIND_INSERT, pos1=i, seq=seq,
+                         refseq=seq - 1, client=0, op_id=i, length=1,
+                         min_seq=max(0, seq - 2)))
+        seq += 1
+        rows.append(dict(kind=KIND_REMOVE, pos1=0, pos2=1, seq=seq,
+                         refseq=seq - 1, client=0,
+                         min_seq=max(0, seq - 2)))
+    batch = _raw(rows)
+    program = build_event_graph(_arrays(batch))
+    starts = int(program["prefix"]["chunk_start"][0].sum())
+    # 12 ops at EG_K=16: one span, several absorbed aging breaks
+    assert starts == 1, starts
+    assert program["span_splits"][0] >= 3
+    assert_live_equal(
+        apply_window_impl(make_table(1, 64), batch),
+        apply_batch_egwalker(make_table(1, 64), batch),
+        "remove-heavy burst",
+    )
 
 
 def test_noops_advance_min_seq_through_spans():

@@ -191,7 +191,10 @@ def test_boxcar_carries_traces_intact_roundtrip(alfred):
     server = alfred()
     svc, c = _load(server.port, "tr", "alice")
     try:
-        assert svc.agreed_version == "1.2"
+        # container ops are traced (client:submit), so even on a 1.3
+        # connection the batch is outside the columnar subset and the
+        # driver falls back to the row boxcar — the traces survive
+        assert svc.agreed_version == WIRE_VERSIONS[0]
         with svc.lock:
             t = c.runtime.create_datastore("ds").create_channel(
                 "sharedstring", "t")
@@ -227,6 +230,191 @@ def test_boxcar_carries_traces_intact_roundtrip(alfred):
             c.close()
     finally:
         svc.close()
+
+
+def _columnar_batch(texts, csn0=1, refseq=0):
+    """An untraced insert batch inside the columnar subset, carrying
+    the canonical batchManager.ts marks (first {batch: true}, last
+    {batch: false})."""
+    from fluidframework_tpu.models.mergetree.ops import InsertOp
+    from fluidframework_tpu.protocol.constants import mark_batch
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+
+    n = len(texts)
+    pos = 0
+    ops = []
+    for i, text in enumerate(texts):
+        metadata = None
+        if n > 1 and i == 0:
+            metadata = mark_batch(None, True)
+        elif n > 1 and i == n - 1:
+            metadata = mark_batch(None, False)
+        ops.append(DocumentMessage(
+            client_sequence_number=csn0 + i,
+            reference_sequence_number=refseq,
+            type=MessageType.OPERATION,
+            contents=InsertOp(pos1=pos, text=text),
+            metadata=metadata,
+        ))
+        pos += len(text)
+    return ops
+
+
+def _capture_sends(svc):
+    sent = []
+    orig = svc._send
+
+    def send(data):
+        sent.append(data)
+        orig(data)
+
+    svc._send = send
+    return sent
+
+
+def test_columnar_batch_roundtrips_live(alfred):
+    """On a 1.3 connection, an untraced batch inside the columnar
+    subset goes out as ONE submitOp frame whose payload IS the column
+    layout — no "ops" array — and the service sequences the whole
+    batch atomically: the sequenced broadcasts and the op log both
+    return the ops decoded intact."""
+    server = alfred()
+    svc = SocketDocumentService("127.0.0.1", server.port, "cols",
+                                timeout=15.0)
+    got = []
+    try:
+        conn = svc.connect_to_delta_stream("colclient", got.append)
+        assert svc.agreed_version == "1.3"
+        sent = _capture_sends(svc)
+        for op in _columnar_batch(["col", "umn", "ar"]):
+            conn.submit(op)
+        frames = [f for f in sent if f.get("type") == "submitOp"]
+        assert len(frames) == 1 and "ops" not in frames[0]
+        cols = frames[0]["cols"]
+        assert cols["n"] == 3 and cols["text"] == "columnar"
+        assert cols["text_off"] == [0, 3, 6, 8]
+        deadline = time.time() + 10.0
+        while time.time() < deadline and len(
+                [m for m in got if m.client_id == "colclient"]) < 3:
+            time.sleep(0.02)
+        mine = [m for m in got if m.client_id == "colclient"]
+        assert [m.client_sequence_number for m in mine] == [1, 2, 3]
+        assert [m.contents.text for m in mine] == ["col", "umn", "ar"]
+        # the batch marks arrive re-derived, positionally
+        assert [m.metadata for m in mine] == [
+            {"batch": True}, None, {"batch": False}]
+        # the op log agrees (columns decoded ONCE, at the sequencer)
+        with svc.lock:
+            logged = [m for m in svc.read_ops(0)
+                      if m.client_id == "colclient"]
+        assert [m.contents.text for m in logged] == \
+            ["col", "umn", "ar"]
+        conn.disconnect()
+    finally:
+        svc.close()
+
+
+def test_columnar_falls_back_to_rows_for_12_peer(alfred):
+    """The same batch against a 1.2-agreed connection rides the
+    wire-1.2 row boxcar unchanged — the columnar form is never sent
+    to a peer that did not negotiate it."""
+    server = alfred()
+    svc = SocketDocumentService("127.0.0.1", server.port, "cols12",
+                                timeout=15.0,
+                                wire_versions=("1.2", "1.1", "1.0"))
+    got = []
+    try:
+        conn = svc.connect_to_delta_stream("oldclient", got.append)
+        assert svc.agreed_version == "1.2"
+        sent = _capture_sends(svc)
+        for op in _columnar_batch(["row", "s"]):
+            conn.submit(op)
+        frames = [f for f in sent if f.get("type") == "submitOp"]
+        assert len(frames) == 1 and "cols" not in frames[0]
+        assert [o["client_sequence_number"]
+                for o in frames[0]["ops"]] == [1, 2]
+        deadline = time.time() + 10.0
+        while time.time() < deadline and len(
+                [m for m in got if m.client_id == "oldclient"]) < 2:
+            time.sleep(0.02)
+        assert [m.client_sequence_number for m in got
+                if m.client_id == "oldclient"] == [1, 2]
+        conn.disconnect()
+    finally:
+        svc.close()
+
+
+def _columnar_session(doc, versions):
+    from fluidframework_tpu.service.ingress import _ClientSession
+
+    server = AlfredServer()
+    session = _ClientSession(server, None)
+    server._sessions.add(session)
+    server._dispatch(session, {
+        "type": "connect_document", "document_id": doc,
+        "client_id": "m", "mode": "write", "versions": versions,
+    }, 0)
+    _session_frames(session)  # drain the handshake
+    return server, session
+
+
+def test_malformed_columns_nacked_before_slicing():
+    """A length-mismatched column refuses the batch AS A UNIT with a
+    BAD_REQUEST nack naming the column — the whole layout is
+    validated before anything slices it, so nothing sequences."""
+    from fluidframework_tpu.protocol.columnar import encode_columns
+    from fluidframework_tpu.protocol.messages import NackErrorType
+
+    server, session = _columnar_session("mal", ["1.3"])
+    cols = encode_columns(_columnar_batch(["ok", "ops"]))
+    assert cols is not None
+    cols["pos1"] = cols["pos1"] + [7]  # length mismatch
+    server._dispatch(session, {
+        "type": "submitOp", "document_id": "mal", "cols": cols,
+    }, 0)
+    nacks = [f for f in _session_frames(session)
+             if f["type"] == "nack"]
+    assert len(nacks) == 1
+    assert nacks[0]["error_type"] == int(NackErrorType.BAD_REQUEST)
+    assert "pos1" in nacks[0]["message"]
+    # nothing sequenced: the op log holds no OPERATION messages
+    server._dispatch(session, {
+        "type": "read_ops", "document_id": "mal", "rid": 1,
+        "from_seq": 0, "to_seq": None,
+    }, 0)
+    ops_frames = [f for f in _session_frames(session)
+                  if f["type"] == "ops"]
+    assert not [m for m in ops_frames[0]["msgs"] if m["type"] == 2]
+
+
+def test_columnar_requires_wire_13():
+    """Server-side enforcement: a 1.2-agreed connection sending a
+    cols frame gets the loud version error, not a silent accept."""
+    from fluidframework_tpu.protocol.columnar import encode_columns
+
+    server, session = _columnar_session("enf13", ["1.2"])
+    cols = encode_columns(_columnar_batch(["nope"]))
+    with pytest.raises(ValueError, match="wire version >= 1.3"):
+        server._dispatch(session, {
+            "type": "submitOp", "document_id": "enf13", "cols": cols,
+        }, 0)
+
+
+def test_traced_batch_falls_back_to_rows_on_13():
+    """A batch whose ops carry traces is outside the columnar subset
+    (the column layout has no traces column): the encoder refuses it
+    and the driver's flush keeps the row boxcar, traces intact."""
+    from fluidframework_tpu.obs.trace import stamp as trace_stamp
+    from fluidframework_tpu.protocol.columnar import encode_columns
+
+    ops = _columnar_batch(["tr", "aced"])
+    assert encode_columns(ops) is not None
+    for op in ops:
+        trace_stamp(op.traces, "client", "submit")
+    assert encode_columns(ops) is None
 
 
 def test_traces_optional_on_wire_10_peer_interops(alfred):
@@ -543,7 +731,9 @@ def _minimal_frame(ftype):
                 if not opt and not tol}
     pool = required or {f: s[0] for f, s in spec.items()}
     floor = min(pool.values(), key=_ver)
-    frame = {} if ftype.startswith("msg:") else {"type": ftype}
+    # payload pseudo-types ("msg:*", "cols:columnar") are not frames:
+    # no discriminator key
+    frame = {} if ":" in ftype else {"type": ftype}
     for fld, since in required.items():
         if since == floor:
             frame[fld] = _sample_value(ftype, fld)
@@ -582,6 +772,17 @@ _SAMPLES = {
     "traces": lambda: [],
 }
 _SAMPLE_OVERRIDES = {
+    # a mutually consistent single-insert columnar payload (the
+    # columns are parallel arrays, so the per-field samples must
+    # agree: one insert of "gen" at position 0)
+    ("cols:columnar", "n"): 1,
+    ("cols:columnar", "csn"): lambda: [1],
+    ("cols:columnar", "refseq"): lambda: [0],
+    ("cols:columnar", "kind"): lambda: [0],
+    ("cols:columnar", "pos1"): lambda: [0],
+    ("cols:columnar", "pos2"): lambda: [0],
+    ("cols:columnar", "text_off"): lambda: [0, 3],
+    ("cols:columnar", "text"): "gen",
     ("summary", "summary"): lambda: __import__(
         "fluidframework_tpu.protocol.serialization",
         fromlist=["encode_contents"]).encode_contents(
@@ -829,6 +1030,21 @@ def _route_document_payload(frame, floor, monkeypatch):
     assert decoded.client_sequence_number == 1
 
 
+def _route_columnar_payload(frame, floor, monkeypatch):
+    from fluidframework_tpu.protocol.columnar import (
+        decode_columns,
+        encode_columns,
+        validate_columns,
+    )
+
+    assert validate_columns(frame) == 1
+    decoded = decode_columns(frame)
+    assert decoded[0].client_sequence_number == 1
+    assert decoded[0].contents.text == "gen"
+    # the codec pair is a faithful round trip on its whole subset
+    assert encode_columns(decoded) == frame
+
+
 _GEN_ROUTES = {
     "connect_document": _route_connect_document,
     "connected": _route_connected,
@@ -850,6 +1066,7 @@ _GEN_ROUTES = {
     "slo": _route_slo,
     "msg:sequenced": _route_sequenced_payload,
     "msg:document": _route_document_payload,
+    "cols:columnar": _route_columnar_payload,
 }
 
 

@@ -225,6 +225,41 @@ def _drive_live_vocabulary(alfred):
     finally:
         svc.close()
 
+    # wire-1.3 columnar batch: container ops are traced (outside the
+    # columnar subset), so the cols:columnar vocabulary needs a
+    # direct untraced batch through the driver's flush path
+    from fluidframework_tpu.protocol.constants import mark_batch
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+    from fluidframework_tpu.models.mergetree.ops import InsertOp
+
+    svc2 = SocketDocumentService("127.0.0.1", server.port, "ws-cols",
+                                 timeout=15.0)
+    got = []
+    try:
+        conn = svc2.connect_to_delta_stream("colclient", got.append)
+        assert svc2.agreed_version == "1.3"
+        marks = [mark_batch(None, True), mark_batch(None, False)]
+        for i, text in enumerate(("co", "ls")):
+            conn.submit(DocumentMessage(
+                client_sequence_number=i + 1,
+                reference_sequence_number=0,
+                type=MessageType.OPERATION,
+                contents=InsertOp(pos1=2 * i, text=text),
+                metadata=marks[i],
+            ))
+        deadline = time.time() + 10.0
+        while time.time() < deadline and len(
+                [m for m in got if m.client_id == "colclient"]) < 2:
+            time.sleep(0.02)
+        assert len([m for m in got
+                    if m.client_id == "colclient"]) == 2
+        conn.disconnect()
+    finally:
+        svc2.close()
+
 
 def test_runtime_wire_traffic_is_subset_of_static_schema(alfred):
     """THE closing of the loop: drive the real 20-seed chaos sweep
