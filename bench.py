@@ -43,7 +43,7 @@ import time
 STAGES = ("probe", "fuzz", "config1", "config2", "config3", "config4",
           "config5", "config6", "config7", "config8", "config9",
           "config10", "config11", "config12", "config13", "config14",
-          "config15")
+          "config15", "config16")
 
 # Machine-readable corpus identity, stamped into EVERY stage record
 # (r5 silently changed the stream mix — flow-mix quarter joined — and
@@ -72,6 +72,7 @@ STAGE_CORPUS = {
                  "changed": "r6: remove-heavy quarter joined "
                             "(event-splitting evidence)"},
     "config15": {"generator": "columnar-pack-mix", "version": 1},
+    "config16": {"generator": "heat-attribution", "version": 1},
 }
 
 
@@ -2777,6 +2778,108 @@ def stage_config15(scale: str, reps: int, cooldown: float) -> dict:
     return record
 
 
+def stage_config16(scale: str, reps: int, cooldown: float) -> dict:
+    """Heat & cost attribution (obs/heat.py): the same serve_bench
+    sidecar slice with the attribution plane OFF and ON, so the
+    plane's cost is a number and its output is pinned.
+
+    Differentials BEFORE timing:
+
+      x2 bit-equality  two attribution-on runs of one config must
+                       agree on every deterministic field — heat
+                       table top-k and attributed totals included
+                       (the step attribution clock is what makes
+                       the heat plane clock-independent).
+      conservation     the per-document ledger total must equal the
+                       aggregate heat_doc_ms_total counter delta to
+                       float tolerance (two independent sums of the
+                       same per-round charges).
+
+    ACCEPTANCE (non-smoke): attribution overhead on the sidecar
+    dispatch rounds — best-of-N summed round walls, on vs off —
+    stays under 2%.
+    """
+    from fluidframework_tpu.tools.serve_bench import (
+        ServeBenchConfig,
+        run_serve_bench,
+    )
+
+    n_docs, duration, capacity, sc_docs, sc_steps = {
+        "full": (256, 6.0, 1200.0, 64, 120),
+        "cpu": (64, 4.0, 400.0, 16, 80),
+        "smoke": (16, 2.0, 200.0, 4, 30),
+    }[scale]
+
+    def cfg(heat: bool) -> ServeBenchConfig:
+        return ServeBenchConfig(
+            n_docs=n_docs, readers_per_doc=2, duration_s=duration,
+            capacity_ops_per_s=capacity, seed=160,
+            sidecar_docs=sc_docs, sidecar_steps=sc_steps,
+            heat=heat,
+        )
+
+    # --- x2 determinism differential (attribution on) ---------------
+    r_on = run_serve_bench(cfg(heat=True))
+    r_on2 = run_serve_bench(cfg(heat=True))
+    assert r_on.deterministic_fields() == r_on2.deterministic_fields(), (
+        "config16: same-seed attribution runs diverged — the heat "
+        "plane leaked wall-clock into the deterministic fields"
+    )
+    assert r_on.heat_top_docs, (
+        "config16 is vacuous: no device time was attributed")
+
+    # --- conservation: ledger total vs aggregate counter ------------
+    metric_ms = r_on.metrics_delta.get("heat_doc_ms_total", 0.0)
+    err = abs(r_on.heat_attributed_ms - metric_ms)
+    tol = 1e-6 * max(1.0, r_on.heat_attributed_ms)
+    assert err <= tol, (
+        f"config16: attributed device-time not conserved — ledger "
+        f"sum {r_on.heat_attributed_ms} vs heat_doc_ms_total delta "
+        f"{metric_ms} (err {err})"
+    )
+
+    # --- overhead: best-of-N summed sidecar round walls, on vs off --
+    n_reps = max(3, reps)
+
+    def best_wall(heat: bool) -> float:
+        best = None
+        for _ in range(n_reps):
+            time.sleep(min(cooldown, 0.2))
+            wall = run_serve_bench(cfg(heat=heat)).sidecar_rounds_wall_ms
+            best = wall if best is None else min(best, wall)
+        return best
+
+    off_ms = best_wall(False)
+    on_ms = best_wall(True)
+    overhead = (on_ms - off_ms) / off_ms if off_ms > 0 else 0.0
+
+    record = {
+        "sidecar_rounds": r_on.sidecar_rounds,
+        "sidecar_ops": r_on.sidecar_ops,
+        "heat_top_docs": [
+            [k, round(v, 6)] for k, v in r_on.heat_top_docs],
+        "heat_top_tenants": [
+            [k, round(v, 6)] for k, v in r_on.heat_top_tenants],
+        "heat_attributed_ms": round(r_on.heat_attributed_ms, 6),
+        "heat_doc_ms_total_delta": round(metric_ms, 6),
+        "conservation_err_ms": round(err, 9),
+        "parity": "x2 deterministic-field bit-equality (heat top-k "
+                  "included) + ledger-vs-counter conservation",
+        "rounds_wall_ms_off": round(off_ms, 3),
+        "rounds_wall_ms_on": round(on_ms, 3),
+        "attribution_overhead_pct": round(100.0 * overhead, 2),
+        "kernel_ops_per_sec": round(
+            r_on.sidecar_ops / (on_ms / 1000.0), 1)
+        if on_ms > 0 else 0.0,
+    }
+    if scale != "smoke":
+        assert overhead < 0.02, (
+            f"config16: attribution overhead {overhead:.2%} >= 2% "
+            f"(off {off_ms:.3f}ms, on {on_ms:.3f}ms)"
+        )
+    return record
+
+
 STAGE_FNS = {
     "probe": stage_probe,
     "fuzz": stage_fuzz,
@@ -2795,6 +2898,7 @@ STAGE_FNS = {
     "config13": stage_config13,
     "config14": stage_config14,
     "config15": stage_config15,
+    "config16": stage_config16,
 }
 
 

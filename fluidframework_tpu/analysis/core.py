@@ -213,7 +213,8 @@ FAMILY_RULES = {
                    "jit-tracer-branch", "jit-static-unhashable",
                    "dispatch-loop-sync"),
     "lockcheck": ("lock-unlocked-write", "lock-external-write"),
-    "obscheck": ("obs-untimed-hop", "slo-unbound-objective"),
+    "obscheck": ("obs-untimed-hop", "slo-unbound-objective",
+                 "undocumented-metric"),
     "qoscheck": ("service-unbounded-queue", "retry-without-jitter",
                  "fence-before-fanout", "unbounded-blocking-wait"),
     "concheck": ("lock-order-cycle", "async-blocking-call",

@@ -112,6 +112,14 @@ DISPATCH_LOOPS = {
         ("stamp",),
         (),
     ),
+    # The heat ledger is charged from the sidecar's settle boundary
+    # and ticked from the mesh pool's dispatch path: its mutation and
+    # read methods must stay pure host math (SoA numpy over
+    # host-resident rows), never a device fetch.
+    "obs/heat.py": (
+        ("ewma_tick", "charge", "get", "pop", "attribute_round"),
+        (),
+    ),
 }
 
 

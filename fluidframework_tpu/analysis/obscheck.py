@@ -29,6 +29,20 @@ literal names — first across the scanned files, then (so a
 partial-path scan of a module whose objectives bind to families
 registered elsewhere stays clean) across the real package tree.
 Dynamic metric names are left to the runtime check.
+
+``undocumented-metric``: every metric family the live tree registers
+must have a row in the repo's metric family index
+(``docs/OBSERVABILITY.md`` — any markdown table whose header has a
+``family`` column), and — staleness both ways, the CANONICAL_HOPS
+contract applied to the doc — every documented family must still be
+registered somewhere: a documented ghost family fails too. The doc
+is discovered by ascending from each scanned file to the nearest
+enclosing directory holding ``docs/OBSERVABILITY.md`` (no doc above
+the scan roots — e.g. a fixture tree — keeps the rule silent), and
+parsed as text, never imported. Files under the doc root's
+``tests/`` and ``examples/`` trees are out of scope: their synthetic
+registries exercise the metrics plane, they are not the serving
+surface the doc indexes.
 """
 from __future__ import annotations
 
@@ -39,6 +53,7 @@ from typing import Optional
 from .core import (
     Finding,
     PKG_ROOT,
+    REPO_ROOT,
     SourceFile,
     dotted_path as _dotted,
     import_aliases,
@@ -282,10 +297,168 @@ def stale_canonical_hops(files: list[SourceFile],
     return sorted(hops - collect_stamped_hops(files))
 
 
+# ------------------------------------------------- metric family index
+
+# the index document, relative to the repo/fixture root it describes
+_OBS_DOC_PARTS = ("docs", "OBSERVABILITY.md")
+
+
+def find_metrics_doc(files: list[SourceFile]) -> Optional[str]:
+    """Nearest enclosing ``docs/OBSERVABILITY.md`` above any scanned
+    file — the ascent is what lets fixture trees carry their own doc
+    (or none, which keeps the rule silent)."""
+    visited: set[str] = set()
+    for src in files:
+        d = os.path.dirname(os.path.abspath(src.abspath))
+        while d not in visited:
+            visited.add(d)
+            cand = os.path.join(d, *_OBS_DOC_PARTS)
+            if os.path.isfile(cand):
+                return cand
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    return None
+
+
+def _row_cells(line: str) -> Optional[list[str]]:
+    stripped = line.strip()
+    if not (stripped.startswith("|") and stripped.endswith("|")):
+        return None
+    return [c.strip() for c in stripped[1:-1].split("|")]
+
+
+def documented_families(doc_path: str) -> dict[str, int]:
+    """family name -> line number, from every row of every markdown
+    table in the doc whose header has a ``family`` column. The first
+    cell is the family reference: backticks stripped, a ``{labels}``
+    suffix dropped (rows document the labelled series shape)."""
+    out: dict[str, int] = {}
+    in_table = False
+    with open(doc_path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            cells = _row_cells(line)
+            if cells is None:
+                in_table = False
+                continue
+            first = cells[0].strip("`").lower()
+            if not in_table:
+                in_table = first == "family"
+                continue
+            if set(first) <= {"-", ":", " "}:
+                continue  # the header/body separator row
+            name = cells[0].strip("`").split("{", 1)[0].strip()
+            if name:
+                out.setdefault(name, lineno)
+    return out
+
+
+def _doc_scope(files: list[SourceFile],
+               doc_path: str) -> list[SourceFile]:
+    doc_root = os.path.dirname(os.path.dirname(doc_path))
+    out = []
+    for src in files:
+        if src.tree is None:
+            continue
+        rel = os.path.relpath(os.path.abspath(src.abspath), doc_root)
+        parts = rel.replace(os.sep, "/").split("/")
+        if parts[0] in ("..", "tests", "examples"):
+            continue
+        out.append(src)
+    return out
+
+
+_ROOT_REGISTRATIONS: dict[str, set] = {}
+
+
+def _root_registrations(doc_root: str) -> set[str]:
+    """Every literal-name family registered anywhere under the doc
+    root (tests/examples excluded, memoized): the ghost-row universe
+    for PARTIAL scans, where the scanned files alone would make every
+    family registered elsewhere in the same repo look like a ghost."""
+    cached = _ROOT_REGISTRATIONS.get(doc_root)
+    if cached is not None:
+        return cached
+    sources = []
+    for dirpath, dirs, fnames in os.walk(doc_root):
+        dirs[:] = [
+            d for d in dirs
+            if not d.startswith(".") and d != "__pycache__"
+            and not (dirpath == doc_root
+                     and d in ("tests", "examples"))
+        ]
+        for fn in sorted(fnames):
+            if fn.endswith(".py"):
+                sources.append(SourceFile(
+                    os.path.join(dirpath, fn), repo_root=doc_root))
+    names = set(collect_registrations(sources))
+    _ROOT_REGISTRATIONS[doc_root] = names
+    return names
+
+
+def _check_documented(files: list[SourceFile],
+                      findings: list) -> None:
+    doc_path = find_metrics_doc(files)
+    if doc_path is None:
+        return
+    scope = _doc_scope(files, doc_path)
+    if not scope:
+        return  # nothing scanned is the doc's business
+    documented = documented_families(doc_path)
+    sites: dict[str, tuple[SourceFile, int]] = {}
+    for src in scope:
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_FACTORIES
+                and node.args
+            ):
+                name = _literal_str(node.args[0])
+                if name is not None:
+                    sites.setdefault(name, (src, node.lineno))
+    doc_rel = os.path.relpath(doc_path, REPO_ROOT).replace(
+        os.sep, "/")
+    for name in sorted(sites):
+        if name in documented:
+            continue
+        src, lineno = sites[name]
+        findings.append(Finding(
+            rule="undocumented-metric",
+            path=src.relpath, line=lineno,
+            message=(
+                f"metric family {name!r} is registered here but has "
+                f"no row in {doc_rel}'s metric family index — add a "
+                "| family | type | meaning | row (operators alert on "
+                "what the doc names; an unindexed family is invisible "
+                "to them)"
+            ),
+            key=name,
+        ))
+    doc_root = os.path.dirname(os.path.dirname(doc_path))
+    universe = set(sites) | _root_registrations(doc_root)
+    for name in sorted(documented):
+        if name in universe:
+            continue
+        findings.append(Finding(
+            rule="undocumented-metric",
+            path=doc_rel, line=documented[name],
+            message=(
+                f"documented metric family {name!r} is registered "
+                "nowhere in the live tree — a ghost row describes "
+                "telemetry nothing emits; delete it or restore the "
+                "registration (staleness is checked both ways)"
+            ),
+            key=name,
+        ))
+
+
 def check(files: list[SourceFile]) -> list[Finding]:
     hops = load_canonical_hops()
     registered = collect_registrations(files)
     findings: list[Finding] = []
+    _check_documented(files, findings)
     for src in files:
         if src.tree is None:
             continue

@@ -116,6 +116,7 @@ RESPONSE_OF = {
     "metrics": "metrics",
     "fleet-metrics": "fleet-metrics",
     "slo": "slo",
+    "heat": "heat",
 }
 
 # leaf method names whose return value is the rid-paired response of

@@ -88,8 +88,9 @@ _SITE_FRAME_IN = _CHAOS.site(
 # wire versions this driver speaks, newest first (the server echoes
 # the agreed one in "connected"; see ingress.WIRE_VERSIONS for what
 # each version adds — 1.1 is the chunked summary-upload plane, 1.2 the
-# boxcarred batch submit, 1.3 the columnar SoA batch submit)
-WIRE_VERSIONS = ("1.3", "1.2", "1.1", "1.0")
+# boxcarred batch submit, 1.3 the columnar SoA batch submit, 1.4 the
+# heat cost-attribution frame)
+WIRE_VERSIONS = ("1.4", "1.3", "1.2", "1.1", "1.0")
 
 
 def build_connect_frame(document_id: str, client_id: str, mode: str,

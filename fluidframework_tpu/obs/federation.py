@@ -85,6 +85,22 @@ def _per_bucket(value: dict) -> dict[str, int]:
     return out
 
 
+def merge_top_k(per_node: list, k: int) -> list:
+    """Merge per-node top-k cuts (lists of ``[key, value]``) into one
+    fleet cut: per-key SUM across nodes (a document attributed on two
+    nodes costs their total), then the deterministic heat ordering —
+    descending value, ties ascending by key. Feed it each node's full
+    served cut; like any federated top-k it is exact only down to the
+    per-node cut depth."""
+    totals: dict = {}
+    for entries in per_node:
+        for key, value in entries:
+            totals[key] = totals.get(key, 0.0) + float(value)
+    order = sorted(totals.items(),
+                   key=lambda kv: (-kv[1], str(kv[0])))
+    return [[key, value] for key, value in order[:k]]
+
+
 class FederatedView:
     """Leader + follower + partition-worker registries, one view.
 
@@ -98,6 +114,9 @@ class FederatedView:
         self.clock = clock or time.time
         self._live: dict[str, MetricsRegistry] = {}
         self._static: dict[str, tuple[dict, float]] = {}
+        # node -> {"docs": [[key, ms]...], "tenants": [[key, ms]...]}
+        # (served heat cuts — see add_heat / heat_top_k)
+        self._heat: dict[str, dict] = {}
         self.registry = MetricsRegistry(node="fleet")
         self._g_nodes = self.registry.gauge(
             "fleet_nodes", "node registries federated into this view")
@@ -127,6 +146,28 @@ class FederatedView:
 
     def nodes(self) -> list[str]:
         return sorted(set(self._live) | set(self._static))
+
+    # -- heat (cost attribution, obs/heat.py) ---------------------------
+
+    def add_heat(self, node: str, docs: list, tenants: list) -> None:
+        """Federate one node's served heat cut (the ``docs`` /
+        ``tenants`` lists of its ``heat`` frame). One node id, one
+        cut: re-adding replaces, like add_registry/add_snapshot."""
+        self._heat[node] = {
+            "docs": [list(e) for e in docs],
+            "tenants": [list(e) for e in tenants],
+        }
+
+    def heat_top_k(self, k: int = 10) -> dict:
+        """The fleet heat view: per-key sums across every federated
+        node cut, re-ranked by the deterministic heat ordering."""
+        nodes = sorted(self._heat)
+        return {
+            "docs": merge_top_k(
+                [self._heat[n]["docs"] for n in nodes], k),
+            "tenants": merge_top_k(
+                [self._heat[n]["tenants"] for n in nodes], k),
+        }
 
     # -- the merge ------------------------------------------------------
 

@@ -58,6 +58,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..obs import metrics as obs_metrics
+from ..obs.heat import HeatLedger
 from ..obs.trace import stamp as _trace_stamp
 from ..ops.bucket_ladder import BucketLadder
 from ..ops.event_graph import validate_executor
@@ -204,7 +205,8 @@ class MeshShardedPool:
                  executor: Optional[str] = None,
                  doc_axis: str = DOC_AXIS,
                  heat_decay: float = 0.5,
-                 timeline=None):
+                 timeline=None,
+                 heat: Optional[HeatLedger] = None):
         if doc_axis not in mesh.axis_names:
             raise ValueError(
                 f"mesh pool needs a {doc_axis!r} mesh axis "
@@ -254,9 +256,16 @@ class MeshShardedPool:
         # subsumed can never dispatch again
         self.applied_upto: dict[int, int] = {}
         # per-member heat: EWMA of dispatched tail depth, decayed
-        # every dispatching settle — what the migration policy reads
+        # every dispatching settle — what the migration policy reads.
+        # Lives on the shared HeatLedger (obs/heat.py) since PR18, so
+        # the same signal the migration heuristic reads is visible to
+        # metrics/federation; pass a shared ledger to co-own it with
+        # the attribution plane, or let the pool keep a private one.
+        # The cap must exceed any member population the pool can hold
+        # (an eviction here would silently zero a live member's heat).
         self.heat_decay = heat_decay
-        self._heat: dict[int, float] = {}
+        self.heat = heat if heat is not None else HeatLedger(
+            max_keys=1 << 16, decay=heat_decay)
         self._table: Optional[SegmentTable] = None
         self.dispatch_count = 0
         self.last_dispatch_depth = 0
@@ -391,7 +400,7 @@ class MeshShardedPool:
         else:
             return
         self.applied_upto.pop(slot, None)
-        self._heat.pop(slot, None)
+        self.heat.pop(slot)
         self._reindex()
 
     def rebuild(self, streams) -> None:
@@ -450,16 +459,15 @@ class MeshShardedPool:
     # -- migration -----------------------------------------------------
 
     def _update_heat(self, depths: dict) -> None:
-        for slot in self.row_of:
-            self._heat[slot] = (
-                self._heat.get(slot, 0.0) * self.heat_decay
-                + float(depths.get(slot, 0))
-            )
+        # one vectorized EWMA step on the shared ledger — bit-identical
+        # to the per-slot dict update this replaced (the PR8 parity
+        # differential pins it on a shared ledger too)
+        self.heat.ewma_tick(self.row_of, depths, decay=self.heat_decay)
 
     def shard_loads(self) -> list:
         """Per-shard heat totals (what the migration policy reads)."""
         return [
-            sum(self._heat.get(s, 0.0) for s in members)
+            sum(self.heat.get(s, 0.0) for s in members)
             for members in self.shard_members
         ]
 
@@ -497,8 +505,8 @@ class MeshShardedPool:
         best_peak = loads[hot]
         for slot in sorted(
                 self.shard_members[hot],
-                key=lambda s: (-self._heat.get(s, 0.0), s)):
-            h = self._heat.get(slot, 0.0)
+                key=lambda s: (-self.heat.get(s, 0.0), s)):
+            h = self.heat.get(slot, 0.0)
             if h <= 0.0:
                 continue
             peak = max(loads[hot] - h, loads[cold] + h)

@@ -178,6 +178,16 @@ WIRE_SCHEMA = {
         "report": "1.0",
         "message": "1.0?",
     },
+    # cost-attribution plane (wire 1.4): top-k hot documents and
+    # tenants off the heat/usage ledgers (obs/heat.py). "k" is the
+    # optional requested cut — omitted, the server serves its
+    # default.
+    "heat": {
+        "rid": "1.4~",
+        "k": "1.4?",
+        "docs": "1.4",
+        "tenants": "1.4",
+    },
     # op payload vocabularies (not frames; see note above)
     "msg:sequenced": {
         "clientId": "1.0",

@@ -26,11 +26,18 @@ prints the live ``slo_report`` (per-objective verdicts + context);
     python -m fluidframework_tpu.service --dump-fleet HOST:PORT
 
 prints the FEDERATED metrics view (obs/federation.py — leader +
-follower + partition-worker registries merged, node-labelled).
+follower + partition-worker registries merged, node-labelled); and
+
+    python -m fluidframework_tpu.service --dump-heat HOST:PORT
+
+prints the cost-attribution view (obs/heat.py — top-k hot documents
+by attributed device-ms and top-k tenants off the usage ledger;
+``--top-k N`` overrides the server's default cut).
 """
 from __future__ import annotations
 
 import argparse
+from typing import Optional
 
 from .ingress import run_server
 
@@ -104,6 +111,34 @@ def dump_slo(target: str) -> int:
     return 0
 
 
+def dump_heat(target: str, k: Optional[int] = None) -> int:
+    """Connect to a running service and print its heat view (top-k
+    hot documents + tenants off the attribution ledgers)."""
+    import json
+    import socket
+
+    from .ingress import _parse_hostport, pack_frame, recv_frame_blocking
+
+    host, port = _parse_hostport(target)
+    req = {"type": "heat", "rid": 1}
+    if k is not None:
+        # optional-presence wire field: emitted only when the caller
+        # asked for a specific cut (the server serves its default
+        # otherwise)
+        req["k"] = k
+    with socket.create_connection((host, port), timeout=10.0) as sock:
+        sock.sendall(pack_frame(req))
+        frame = recv_frame_blocking(sock)
+    if frame.get("type") != "heat":
+        print(f"unexpected response: {frame}")
+        return 1
+    print(json.dumps(
+        {"docs": frame.get("docs", []),
+         "tenants": frame.get("tenants", [])},
+        indent=2, sort_keys=True))
+    return 0
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(
         prog="python -m fluidframework_tpu.service",
@@ -156,6 +191,15 @@ def main() -> None:
                              "partition-worker registries merged; "
                              "Prometheus text, --json for the "
                              "snapshot) and exit")
+    parser.add_argument("--dump-heat", default=None,
+                        metavar="HOST:PORT",
+                        help="print a RUNNING service's cost-"
+                             "attribution view (top-k hot documents "
+                             "by attributed device-ms + top-k "
+                             "tenants, JSON) and exit")
+    parser.add_argument("--top-k", type=int, default=None,
+                        help="with --dump-heat: ask for this cut "
+                             "instead of the server default")
     parser.add_argument("--json", action="store_true",
                         help="with --dump-metrics/--dump-fleet: emit "
                              "the JSON snapshot instead of text "
@@ -167,6 +211,8 @@ def main() -> None:
         raise SystemExit(dump_slo(args.dump_slo))
     if args.dump_fleet is not None:
         raise SystemExit(dump_fleet(args.dump_fleet, args.json))
+    if args.dump_heat is not None:
+        raise SystemExit(dump_heat(args.dump_heat, args.top_k))
     run_server(args.host, args.port, args.data_dir, args.partitions,
                args.broker, qos_enabled=args.qos,
                qos_ops_per_sec=args.qos_ops_per_sec,

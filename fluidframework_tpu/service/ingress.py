@@ -76,7 +76,7 @@ MAX_FRAME = 64 * 1024 * 1024
 _KNOWN_FRAME_KINDS = frozenset((
     "connect_document", "submitOp", "read_ops", "fetch_summary",
     "upload_summary_chunk", "disconnect_document", "metrics", "slo",
-    "fleet-metrics",
+    "fleet-metrics", "heat",
 ))
 _FRAMES = obs_metrics.REGISTRY.counter(
     "ingress_frames_total", "frames dispatched by the ingress",
@@ -119,6 +119,31 @@ _DISPATCH_MS = obs_metrics.REGISTRY.histogram(
     "event-loop occupancy per dispatched frame (decode + ticket + "
     "fanout enqueue)")
 
+# per-tenant usage rollup (the cost-attribution plane, obs/heat.py).
+# AGGREGATE families only — tenant ids are unbounded client input and
+# never become label values (the obs cardinality discipline); exact
+# per-tenant splits live on the usage HeatLedger, LRU-capped, served
+# via the heat frame / --dump-heat.
+_TENANT_OPS_OFFERED = obs_metrics.REGISTRY.counter(
+    "tenant_ops_offered_total",
+    "ops offered by sessions with a tenant identity (connect-token "
+    "claims), shed ones included")
+_TENANT_OPS_TICKETED = obs_metrics.REGISTRY.counter(
+    "tenant_ops_ticketed_total",
+    "tenant-attributed ops that reached the sequencer")
+_TENANT_BYTES_IN = obs_metrics.REGISTRY.counter(
+    "tenant_bytes_in_total",
+    "wire bytes received on frames attributed to a tenant")
+_TENANT_BYTES_OUT = obs_metrics.REGISTRY.counter(
+    "tenant_bytes_out_total",
+    "wire bytes enqueued outbound on tenant-attributed fanout")
+_TENANT_SHEDS = obs_metrics.REGISTRY.counter(
+    "tenant_sheds_total",
+    "qos admission sheds charged to a tenant")
+_TENANT_UPLOADS = obs_metrics.REGISTRY.counter(
+    "tenant_summary_uploads_total",
+    "completed summary uploads charged to a tenant")
+
 # chaos seam (docs/ROBUSTNESS.md): a transient fault on the summary
 # upload plane — fired on the FINAL (rid-waited) chunk so it always
 # reaches the uploader synchronously; the container's summarize
@@ -150,7 +175,13 @@ _SITE_UPLOAD = _CHAOS.site("ingress.summary_upload", (KIND_ERROR,))
 #       (protocol/columnar.py) — validated once, sliced, never
 #       re-interpreted per op. Same atomic-ticket semantics as the
 #       1.2 boxcar; 1.0-1.2 peers keep the row paths unchanged.
-WIRE_VERSIONS = ("1.3", "1.2", "1.1", "1.0")
+# 1.4 — adds the heat frame (cost-attribution plane, obs/heat.py):
+#       top-k hot documents and tenants off the heat/usage ledgers,
+#       with an optional requested cut "k". A connection that
+#       NEGOTIATED <= 1.3 must not send it (server rejects loudly,
+#       same as the 1.1 upload gate); 1.0-1.3 peers see no heat
+#       frames and no behavior change.
+WIRE_VERSIONS = ("1.4", "1.3", "1.2", "1.1", "1.0")
 
 
 def document_message_to_json(op: DocumentMessage) -> dict:
@@ -332,7 +363,18 @@ class _ClientSession:
                         "retry_after_seconds": 1.0,
                     }))
                 return
-        self._put(pack_frame(data))
+        payload = pack_frame(data)
+        if self.server.usage is not None:
+            # per-tenant egress bytes: fanout and replies for a
+            # tenant-attributed document charge the frame's packed
+            # size (the same bytes the socket writes)
+            d = data.get("document_id")
+            tenant = self.tenant_ids.get(d, "") if d else ""
+            if tenant:
+                self.server.usage.charge(
+                    tenant, 0.0, bytes_out=len(payload))
+                _TENANT_BYTES_OUT.inc(len(payload))
+        self._put(payload)
 
     def _put(self, frame: bytes) -> None:
         try:
@@ -402,7 +444,10 @@ class AlfredServer:
                  slo: Optional[Any] = None,
                  fleet: Optional[Any] = None,
                  max_outbound_depth: Optional[int] = None,
-                 outbound_drop_threshold: Optional[int] = None):
+                 outbound_drop_threshold: Optional[int] = None,
+                 heat: Optional[Any] = None,
+                 usage: Optional[Any] = None,
+                 heat_top_k: int = 10):
         self.local = local or LocalServer()
         self.host = host
         self.port = port
@@ -426,6 +471,19 @@ class AlfredServer:
         # registry, built lazily on first ask (the dev-service shape:
         # one process IS the fleet).
         self.fleet = fleet
+        # optional cost-attribution plane (obs/heat.py): `heat` is the
+        # per-document device-time ledger (the sidecar charges it at
+        # its settle boundary), `usage` the per-tenant rollup ledger
+        # this ingress charges at admission/ticket/upload time. Both
+        # None = attribution off, zero cost on the serving path. The
+        # wire-1.4 heat frame serves top-k cuts of both.
+        self.heat = heat
+        self.usage = usage
+        self.heat_top_k = heat_top_k
+        # doc -> tenant identity from the last validated connect (the
+        # sidecar's tenant_of hook reads this; per-session identity
+        # for the rollup stays on session.tenant_ids)
+        self.doc_tenants: dict[str, str] = {}
         self.max_outbound_depth = (
             max_outbound_depth or self.MAX_OUTBOUND_DEPTH
         )
@@ -625,6 +683,11 @@ class AlfredServer:
         defers resubmit by retry_after_seconds); request/response
         sheds answer the rid with a structured throttle error the
         driver converts to a RetriableError."""
+        if self.usage is not None:
+            tenant = session.tenant_ids.get(doc or "", "")
+            if tenant:
+                self.usage.charge(tenant, 0.0, sheds=1)
+                _TENANT_SHEDS.inc()
         if as_nack:
             self._send_nack(session, doc, Nack(
                 operation=None,
@@ -733,6 +796,44 @@ class AlfredServer:
                 "report": self.slo.report(),
             })
             return
+        if kind == "heat":
+            # the cost-attribution plane's scrape point (wire 1.4,
+            # `--dump-heat` reads this): top-k hot documents (by
+            # attributed device-ms) and tenants off the ledgers.
+            # Unauthenticated on a dump connection like `metrics` —
+            # but a session that DID negotiate is held to the compat
+            # matrix: agreeing only pre-1.4 versions and sending the
+            # frame anyway is a protocol error, same discipline as
+            # the 1.1 upload gate.
+            if session.wire_versions and all(
+                    wire_version_lt(v, "1.4")
+                    for v in session.wire_versions.values()):
+                raise ValueError(
+                    "heat frame requires wire version >= 1.4 "
+                    "(connection agreed "
+                    f"{sorted(set(session.wire_versions.values()))})"
+                )
+            k = frame.get("k")
+            cut = int(k) if k is not None else self.heat_top_k
+            docs = (self.heat.top_k(cut)
+                    if self.heat is not None else [])
+            tenants = (self.usage.top_k(cut)
+                       if self.usage is not None else [])
+            session.send({
+                "type": "heat", "rid": frame.get("rid"),
+                "docs": [[key, value] for key, value in docs],
+                "tenants": [[key, value] for key, value in tenants],
+            })
+            return
+        if self.usage is not None and doc:
+            # per-tenant byte ingress: every frame of a
+            # tenant-attributed document charges its wire bytes to
+            # the CONNECT-VALIDATED tenant (never the frame's own
+            # tenant_id — that field is client input)
+            tenant = session.tenant_ids.get(doc, "")
+            if tenant and nbytes:
+                self.usage.charge(tenant, 0.0, bytes_in=nbytes)
+                _TENANT_BYTES_IN.inc(nbytes)
         if kind == "connect_document":
             client_id = frame["client_id"]
             details = frame.get("details") or {}
@@ -797,6 +898,10 @@ class AlfredServer:
                 session.write_authorized.add(doc)
             session.wire_versions[doc] = agreed
             session.tenant_ids[doc] = frame.get("tenant_id") or ""
+            if session.tenant_ids[doc]:
+                # server-level doc -> tenant map: the sidecar's
+                # attribution tenant_of hook resolves through this
+                self.doc_tenants[doc] = session.tenant_ids[doc]
             session.send({
                 "type": "connected", "document_id": doc,
                 "client_id": client_id, "version": agreed,
@@ -880,6 +985,10 @@ class AlfredServer:
             # include what admission shed, or the objective could
             # never see an overload
             _OPS_OFFERED.inc(n_ops)
+            tenant = session.tenant_ids.get(doc or "", "")
+            if self.usage is not None and tenant:
+                self.usage.charge(tenant, 0.0, ops_offered=n_ops)
+                _TENANT_OPS_OFFERED.inc(n_ops)
             adm = self._admit(session, klass, doc, frame,
                               ops=n_ops, nbytes=nbytes)
             if adm is not None:
@@ -906,6 +1015,7 @@ class AlfredServer:
                 # form lazily (rejections only — the served path never
                 # pays a per-op re-encode)
                 ops_json = [None] * len(decoded)
+            ticketed = 0
             for op_json, op in zip(ops_json, decoded):
                 try:
                     conn.submit(op)
@@ -913,6 +1023,7 @@ class AlfredServer:
                     # actually accepted — counting at decode would
                     # read an all-nacked fleet as 100% served
                     _OPS_TICKETED.inc()
+                    ticketed += 1
                 except PermissionError as e:
                     # read-mode connection: reject as a NACK so the
                     # driver's on_nack fires (parity with the in-proc
@@ -928,6 +1039,9 @@ class AlfredServer:
                         "error_type": int(NackErrorType.INVALID_SCOPE),
                         "message": str(e),
                     })
+            if self.usage is not None and tenant and ticketed:
+                self.usage.charge(tenant, 0.0, ops_ticketed=ticketed)
+                _TENANT_OPS_TICKETED.inc(ticketed)
         elif kind == "read_ops":
             adm = self._admit(session, CLASS_CATCHUP, doc, frame)
             if adm is not None:
@@ -996,6 +1110,15 @@ class AlfredServer:
                     return
             self._check_write_access(session, doc, frame)
             self._handle_upload_chunk(session, doc, frame)
+            if self.usage is not None and \
+                    int(frame.get("chunk", 0)) + 1 == \
+                    int(frame.get("total", 1)):
+                # the final chunk staged the tree: one completed
+                # upload charged to the connect-validated tenant
+                tenant = session.tenant_ids.get(doc or "", "")
+                if tenant:
+                    self.usage.charge(tenant, 0.0, summary_uploads=1)
+                    _TENANT_UPLOADS.inc()
         elif kind == "disconnect_document":
             conn = session.connections.pop(doc, None)
             if conn is not None:
@@ -1298,6 +1421,14 @@ def run_server(host: str = "127.0.0.1", port: int = 7070,
     else:
         local = LocalServer(durable_dir=data_dir,
                             storage_breaker=storage_breaker)
+    # cost-attribution plane (obs/heat.py): the per-document heat
+    # ledger (charged by a sidecar when one is wired; served either
+    # way) and the per-tenant usage rollup — both LRU-capped, both
+    # behind the wire-1.4 heat frame / --dump-heat
+    from ..obs.heat import HeatLedger, usage_ledger
+
+    heat = HeatLedger()
+    usage = usage_ledger()
     slo = None
     if slo_enabled:
         from ..obs.slo import SloEngine
@@ -1308,8 +1439,12 @@ def run_server(host: str = "127.0.0.1", port: int = 7070,
             # burn-rate verdicts cite the overload context: "goodput
             # burned through its budget WHILE pressure sat at severe"
             slo.add_context("pressure", qos.pressure.context)
+        # ... and WHO was burning it: every verdict carries the top-k
+        # hot tenants off the usage ledger, so an overload breach
+        # names its cause instead of just its symptom
+        slo.add_context("hot_tenants", lambda: usage.top_k(5))
     server = AlfredServer(local, host=host, port=port, qos=qos,
-                          slo=slo)
+                          slo=slo, heat=heat, usage=usage)
 
     async def main():
         await server.start()

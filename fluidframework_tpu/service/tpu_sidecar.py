@@ -45,7 +45,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -53,6 +53,7 @@ import numpy as np
 from ..models.mergetree import MergeTreeClient
 from ..obs import metrics as obs_metrics
 from ..obs.flight_recorder import FlightRecorder
+from ..obs.heat import HeatLedger, attribute_round
 from ..obs.profiler import device_trace
 from ..obs.trace import stamp as trace_stamp
 from ..ops import (
@@ -581,7 +582,11 @@ class TpuMergeSidecar:
                  donate: Optional[bool] = None,
                  ladder: Optional[BucketLadder] = None,
                  trace_ops: Optional[bool] = None,
-                 breaker=None):
+                 breaker=None,
+                 heat: Optional[HeatLedger] = None,
+                 usage: Optional[HeatLedger] = None,
+                 tenant_of: Optional[Callable] = None,
+                 attr_clock: Optional[Callable[[], float]] = None):
         self.max_docs = max_docs
         self.capacity = capacity
         self.max_capacity = max_capacity
@@ -716,6 +721,24 @@ class TpuMergeSidecar:
         # pipeline instrumentation (bench config7 reads these):
         # host-pack seconds vs settle (device-wait) seconds per round
         self.stats = {"pack_s": 0.0, "settle_s": 0.0, "rounds": 0}
+        # device-time attribution plane (obs/heat.py, OPT-IN): when a
+        # heat ledger is attached, each round's wall-ms (dispatch
+        # start -> that round's settle; consecutive pipelined spans
+        # overlap by the next round's pack on purpose) splits across
+        # the documents active that round proportional to ops applied.
+        # Counts are captured host-side at pack time and charged at
+        # the _settle sync boundary — never a mid-loop device read.
+        # attr_clock is injectable so differential runs (bench
+        # config16) can pin bit-identical tables under a manual clock.
+        self.heat = heat
+        self.usage = usage
+        self.tenant_of = tenant_of
+        self._attr_clock = (attr_clock if attr_clock is not None
+                            else time.perf_counter)
+        self._attr_counts: dict[str, int] = {}
+        self._attr_t0 = 0.0
+        # slot -> document id (attribution reads counts per doc)
+        self._slot_doc: dict[int, str] = {}
         _M_CAPACITY.set(self.capacity)
 
     # ------------------------------------------------------------------
@@ -730,6 +753,7 @@ class TpuMergeSidecar:
             raise RuntimeError("sidecar document capacity exhausted")
         slot = len(self._streams)
         self._slots[key] = slot
+        self._slot_doc[slot] = document_id
         self._doc_slots.setdefault(document_id, []).append(
             (slot, datastore_id, channel_id)
         )
@@ -1002,6 +1026,8 @@ class TpuMergeSidecar:
             raise _SITE_DISPATCH.transient(fault)
         docs = self.max_docs
         t0 = time.perf_counter()
+        # attribution span opens at round start (host clock, opt-in)
+        attr_t0 = self._attr_clock() if self.heat is not None else 0.0
         # HOST HALF — runs while the device still computes the
         # previous round. Coalesce noop runs at pack time (safe here:
         # the queue is consumed whole), then pad the window to a
@@ -1010,6 +1036,22 @@ class TpuMergeSidecar:
         # every flush (20-40s each on the real chip). Pow2 bucketing
         # bounds the shape count to log(n).
         packed = [coalesce_noops(q) for q in self._queued]
+        attr_counts: dict[str, int] = {}
+        if self.heat is not None:
+            # per-document real-op counts off the pack metadata (host
+            # ints; BEFORE the pool tier zeroes its slots out of the
+            # primary window, so pooled docs attribute too). Committed
+            # to self._attr_* only after the in-flight round settles
+            # below — the mid-dispatch _settle charges the PREVIOUS
+            # round from the previous snapshot.
+            for slot, ops in enumerate(packed):
+                if not ops:
+                    continue
+                n = sum(1 for op in ops if op["kind"] != KIND_NOOP)
+                if n:
+                    doc = self._slot_doc.get(slot)
+                    if doc is not None:
+                        attr_counts[doc] = attr_counts.get(doc, 0) + n
         pool_real = 0
         if self._pool is not None:
             # pooled docs dispatch from their canonical-stream tails at
@@ -1088,6 +1130,11 @@ class TpuMergeSidecar:
         self._prev_table = self._table
         self._last_program = program
         self._unsettled = True
+        # commit this round's attribution snapshot now that the
+        # previous round has been charged (in the _settle above)
+        if self.heat is not None:
+            self._attr_counts = attr_counts
+            self._attr_t0 = attr_t0
         # _settle above closed the PREVIOUS round's trace window; this
         # round's messages are now the in-flight set
         if self.trace_ops:
@@ -1124,6 +1171,18 @@ class TpuMergeSidecar:
                 "settle", settle_ms=round(settle_s * 1000.0, 3),
                 overflow=overflowed,
             )
+            if self.heat is not None and self._attr_counts:
+                # the round's wall-ms (dispatch start -> here) splits
+                # across its active documents proportional to ops —
+                # host math over pre-captured ints at the sanctioned
+                # sync boundary (obs/heat.py owns the formula and the
+                # conservation invariant)
+                round_ms = (self._attr_clock() - self._attr_t0) * 1000.0
+                attribute_round(
+                    self.heat, self._attr_counts, round_ms,
+                    usage=self.usage, tenant_of=self.tenant_of,
+                )
+                self._attr_counts = {}
             if self.trace_ops and self._inflight_msgs:
                 settle_t = time.time()
                 for m in self._inflight_msgs:

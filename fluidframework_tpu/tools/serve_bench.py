@@ -144,6 +144,13 @@ class ServeBenchConfig:
     # continuous profiler (wall-clock thread sampler)
     profile: bool = False
     profile_interval_s: float = 0.005
+    # cost attribution (obs/heat.py): per-document device-time split
+    # across sidecar rounds + per-tenant usage rollup. The attribution
+    # clock is a deterministic STEP clock (fixed increment per read),
+    # so same-config runs produce bit-identical heat tables and top-k
+    # — config16's x2 differential depends on it.
+    heat: bool = False
+    heat_top_k: int = 8
 
 
 @dataclass
@@ -163,6 +170,7 @@ class ServeBenchReport:
     sidecar_ops: int = 0
     sidecar_round_p50_ms: Optional[float] = None
     sidecar_round_p99_ms: Optional[float] = None
+    sidecar_rounds_wall_ms: float = 0.0
     route_split_sidecar: float = 0.0
     # SLO plane
     slo_report: dict = field(default_factory=dict)
@@ -174,12 +182,17 @@ class ServeBenchReport:
     # fleet surface: the node ids the ingress's FederatedView merges
     # (one node here; the replicated plane grows the list)
     fleet_nodes: list = field(default_factory=list)
+    # cost attribution (heat=True; empty otherwise). Deterministic:
+    # the attribution plane runs on the step clock, not wall time.
+    heat_top_docs: list = field(default_factory=list)
+    heat_top_tenants: list = field(default_factory=list)
+    heat_attributed_ms: float = 0.0
     wall_s: float = 0.0
     metrics_delta: dict = field(default_factory=dict)
 
     def deterministic_fields(self) -> dict:
         """The subset that must be bit-equal run-to-run for the same
-        config (everything the manual clock governs; wall-clock
+        config (everything the manual/step clocks govern; wall-clock
         figures — sidecar round times, profiler, wall_s — excluded)."""
         return {
             "offered_ops": self.offered_ops,
@@ -190,6 +203,9 @@ class ServeBenchReport:
             "backlog_peak": self.backlog_peak,
             "max_pressure_tier": self.max_pressure_tier,
             "sidecar_ops": self.sidecar_ops,
+            "heat_top_docs": self.heat_top_docs,
+            "heat_top_tenants": self.heat_top_tenants,
+            "heat_attributed_ms": self.heat_attributed_ms,
         }
 
 
@@ -264,7 +280,33 @@ def _pct(sorted_arr: list, q: float) -> Optional[float]:
                           int(len(sorted_arr) * q))]
 
 
-def _build_sidecar(cfg: ServeBenchConfig):
+class _StepClock:
+    """Deterministic attribution clock: each read advances a fixed
+    step, so a dispatch round's span is (reads between)*step —
+    identical every run. Wall time never enters the heat plane."""
+
+    def __init__(self, step_s: float = 0.001):
+        self.t = 0.0
+        self.step_s = step_s
+
+    def __call__(self) -> float:
+        self.t += self.step_s
+        return self.t
+
+
+def _tenant_of_doc(doc: str) -> str:
+    """Deterministic doc→tenant assignment for the harness: sdoc-<d>
+    bills tenant-<d mod 3> (a skewed-enough split that top-k has an
+    ordering to get right)."""
+    try:
+        d = int(doc.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return "tenant-0"
+    return f"tenant-{d % 3}"
+
+
+def _build_sidecar(cfg: ServeBenchConfig, heat_ledger=None,
+                   usage=None, attr_clock=None):
     """The sidecar-routed document population (config7's feeding
     idiom: canonical encoded streams installed per slot, round
     slices queued directly). Lazy import: a host-only run must not
@@ -276,6 +318,9 @@ def _build_sidecar(cfg: ServeBenchConfig):
     sidecar = TpuMergeSidecar(
         max_docs=cfg.sidecar_docs, capacity=cfg.sidecar_capacity,
         max_capacity=cfg.sidecar_capacity * 4,
+        heat=heat_ledger, usage=usage,
+        tenant_of=_tenant_of_doc if usage is not None else None,
+        attr_clock=attr_clock,
     )
     encs = []
     for i in range(cfg.sidecar_streams):
@@ -345,11 +390,23 @@ def run_serve_bench(config: Optional[ServeBenchConfig] = None
             })
     report.sessions = len(server._sessions)
 
+    # --- cost attribution (obs/heat.py) ------------------------------
+    heat_ledger = None
+    usage = None
+    if cfg.heat:
+        from ..obs.heat import HeatLedger, usage_ledger
+
+        attr_clock = _StepClock()
+        heat_ledger = HeatLedger(clock=attr_clock)
+        usage = usage_ledger(clock=attr_clock)
+
     # --- sidecar route split ----------------------------------------
     sidecar = None
     sidecar_round_ms: list = []
     if cfg.sidecar_docs > 0:
-        sidecar, sidecar_encs = _build_sidecar(cfg)
+        sidecar, sidecar_encs = _build_sidecar(
+            cfg, heat_ledger=heat_ledger, usage=usage,
+            attr_clock=attr_clock if cfg.heat else None)
         sidecar_rounds_total = int(
             max(len(e.ops) for e in sidecar_encs)
             + cfg.sidecar_round_ops - 1) // cfg.sidecar_round_ops
@@ -394,6 +451,10 @@ def run_serve_bench(config: Optional[ServeBenchConfig] = None
     if pressure is not None:
         engine.add_context("pressure", pressure.context)
     engine.add_context("backlog", lambda: len(pending))
+    if usage is not None:
+        # breach verdicts arrive with the likely payers attached
+        engine.add_context(
+            "hot_tenants", lambda: usage.top_k(cfg.heat_top_k))
     if sidecar is not None:
         engine.add_dump_target(sidecar.flight)
 
@@ -491,6 +552,7 @@ def run_serve_bench(config: Optional[ServeBenchConfig] = None
     rounds = sorted(sidecar_round_ms)
     report.sidecar_round_p50_ms = _pct(rounds, 0.5)
     report.sidecar_round_p99_ms = _pct(rounds, 0.99)
+    report.sidecar_rounds_wall_ms = float(sum(sidecar_round_ms))
     total_served = report.acked_ops + report.sidecar_ops
     report.route_split_sidecar = (
         report.sidecar_ops / total_served if total_served else 0.0)
@@ -499,6 +561,13 @@ def run_serve_bench(config: Optional[ServeBenchConfig] = None
     if profiler is not None:
         report.profiler = profiler.summary()
     report.fleet_nodes = fleet.nodes()
+    if heat_ledger is not None:
+        report.heat_top_docs = [
+            [k, v] for k, v in heat_ledger.top_k(cfg.heat_top_k)]
+        report.heat_top_tenants = [
+            [k, v] for k, v in usage.top_k(cfg.heat_top_k)]
+        report.heat_attributed_ms = float(sum(
+            heat_ledger.get(k) for k in heat_ledger.keys()))
     report.wall_s = time.perf_counter() - wall0
     report.metrics_delta = obs_metrics.REGISTRY.delta(before)
     return report
@@ -518,6 +587,7 @@ def main(argv: Optional[list] = None) -> int:  # pragma: no cover
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--profile", action="store_true")
     parser.add_argument("--no-qos", action="store_true")
+    parser.add_argument("--heat", action="store_true")
     args = parser.parse_args(argv)
     report = run_serve_bench(ServeBenchConfig(
         n_docs=args.docs, duration_s=args.duration,
@@ -525,6 +595,7 @@ def main(argv: Optional[list] = None) -> int:  # pragma: no cover
         capacity_ops_per_s=args.capacity,
         sidecar_docs=args.sidecar_docs, seed=args.seed,
         profile=args.profile, qos=not args.no_qos,
+        heat=args.heat,
     ))
     out = dataclasses.asdict(report)
     out.pop("metrics_delta")  # bulky; the bench record carries it

@@ -65,7 +65,16 @@ def check_stream(stream):
 # ----------------------------------------------------------------------
 # 1. scale-up fuzz: 120 seeds across four adversarial mixes
 
-@pytest.mark.parametrize("seed", range(40))
+
+def _smoke(n, keep):
+    """range(n) with every seed outside ``keep`` slow-marked — tier-1
+    runs a smoke subset of the sweep, the full sweep is slow-lane."""
+    return [
+        s if s in keep else pytest.param(s, marks=pytest.mark.slow)
+        for s in range(n)
+    ]
+
+@pytest.mark.parametrize("seed", _smoke(40, {0, 1, 2, 3, 4}))
 def test_fuzz_eight_clients_deep_concurrency(seed):
     _, stream = record_op_stream(FuzzConfig(
         n_clients=8, n_steps=220, seed=10_000 + seed * 13,
@@ -75,7 +84,7 @@ def test_fuzz_eight_clients_deep_concurrency(seed):
     check_stream(stream)
 
 
-@pytest.mark.parametrize("seed", range(30))
+@pytest.mark.parametrize("seed", _smoke(30, {0, 1, 2, 3, 4}))
 def test_fuzz_overlap_remove_storm(seed):
     """Remove-heavy with rare processing: most removes overlap
     concurrently (the overlapRemove bookkeeping,
@@ -88,7 +97,7 @@ def test_fuzz_overlap_remove_storm(seed):
     check_stream(stream)
 
 
-@pytest.mark.parametrize("seed", range(30))
+@pytest.mark.parametrize("seed", _smoke(30, {0, 1, 2, 3, 4}))
 def test_fuzz_annotate_storm_with_insert_props(seed):
     _, stream = record_op_stream(FuzzConfig(
         n_clients=5, n_steps=200, seed=30_000 + seed * 11,
@@ -98,7 +107,7 @@ def test_fuzz_annotate_storm_with_insert_props(seed):
     check_stream(stream)
 
 
-@pytest.mark.parametrize("seed", range(20))
+@pytest.mark.parametrize("seed", _smoke(20, {0, 1, 2, 3, 4}))
 def test_fuzz_msn_boundary_churn(seed):
     """Heavy processing keeps the msn advancing through the op storm,
     so zamboni-eligible tombstones cross the window constantly."""

@@ -238,6 +238,85 @@ def test_slo_unbound_objective_rule_fires_on_unregistered_metric(
     ) == []
 
 
+def test_undocumented_metric_rule_staleness_both_ways(tmp_path):
+    """The undocumented-metric rule (obscheck family): a registered
+    family with no row in the fixture tree's docs/OBSERVABILITY.md
+    fails; a documented ghost family nothing registers fails too; a
+    documented + registered family passes. Scope: the doc is found
+    by ascent, and files under tests/ are not the doc's business."""
+    root = tmp_path / "repo"
+    (root / "docs").mkdir(parents=True)
+    (root / "docs" / "OBSERVABILITY.md").write_text(
+        "# Observability\n\n"
+        "| family | type | meaning |\n"
+        "|---|---|---|\n"
+        "| `fixture_documented_total` | counter | documented |\n"
+        "| `fixture_ghost_family_total` | counter | nothing "
+        "registers this |\n"
+    )
+    mod = root / "plane.py"
+    mod.write_text(
+        "def wire(reg):\n"
+        "    reg.counter('fixture_documented_total', 'ok')\n"
+        "    reg.counter('fixture_undocumented_total', 'missing "
+        "row')\n"
+        "    name = 'dyn_total'\n"
+        "    reg.counter(name, 'dynamic: runtime concern')\n"
+    )
+    findings = core.run_analysis(
+        roots=[str(mod)], families=["obscheck"],
+    )
+    assert sorted(f.key for f in findings) == [
+        "fixture_ghost_family_total", "fixture_undocumented_total",
+    ]
+    assert all(f.rule == "undocumented-metric" for f in findings)
+    ghost = next(f for f in findings
+                 if f.key == "fixture_ghost_family_total")
+    assert ghost.path.endswith("docs/OBSERVABILITY.md")
+    missing = next(f for f in findings
+                   if f.key == "fixture_undocumented_total")
+    assert missing.path.endswith("plane.py")
+
+    # a registry driven from under tests/ is a synthetic test rig,
+    # not serving surface: out of the doc's scope
+    tdir = root / "tests"
+    tdir.mkdir()
+    rig = tdir / "test_rig.py"
+    rig.write_text(
+        "def rig(reg):\n"
+        "    reg.counter('rig_only_total', 'synthetic')\n"
+    )
+    assert core.run_analysis(
+        roots=[str(rig)], families=["obscheck"],
+    ) == []
+
+    # no docs/OBSERVABILITY.md above the scan roots (plain fixture
+    # trees): the rule is silent, not a false-positive storm
+    bare = tmp_path / "bare.py"
+    bare.write_text(
+        "def wire(reg):\n"
+        "    reg.counter('undocumented_anywhere_total', 'x')\n"
+    )
+    assert core.run_analysis(
+        roots=[str(bare)], families=["obscheck"],
+    ) == []
+
+
+def test_undocumented_metric_live_tree_is_clean():
+    """The acceptance bar for the heat PR's doc satellite: every
+    family the real tree registers has a row in docs/
+    OBSERVABILITY.md's metric family index, no ghost rows, NOTHING
+    allowlisted — the doc can be trusted as the complete operator
+    surface."""
+    kept, _stale, allowlist = _gate()
+    mine = [f for f in kept if f.rule == "undocumented-metric"]
+    assert mine == [], "\n".join(f.format() for f in mine)
+    assert not [e for e in allowlist
+                if e[0] == "undocumented-metric"], (
+        "undocumented-metric must not be allowlisted — document the "
+        "family instead")
+
+
 def test_service_unbounded_queue_rule_fires_in_service_paths(
         tmp_path):
     """The service-unbounded-queue rule (qoscheck family): an
@@ -854,7 +933,7 @@ def test_family_rules_map_stays_complete():
     assert set(core.FAMILY_RULES) == set(core.FAMILIES)
     for rule in ("layer-undeclared", "jit-nondeterminism",
                  "lock-unlocked-write", "obs-untimed-hop",
-                 "slo-unbound-objective",
+                 "slo-unbound-objective", "undocumented-metric",
                  "service-unbounded-queue", "lock-order-cycle",
                  "async-blocking-call", "await-holding-lock",
                  "dispatch-loop-sync", "donated-buffer-reuse",

@@ -1,0 +1,267 @@
+"""Cost attribution plane (obs/heat.py): the deterministic HeatLedger
+and the sidecar device-time attribution built on it.
+
+The pins, in order of load-bearing-ness:
+
+- CONSERVATION: attribute_round splits a round's wall-ms across its
+  documents proportional to ops — the per-doc charges must sum back
+  to the round total (up to float rounding), every round, and the
+  aggregate heat_doc_ms_total counter must agree with the ledger.
+- DETERMINISM: the ledger is pure host math over SoA float64 — two
+  identical charge/tick sequences produce bit-identical snapshots
+  and top-k cuts (ties break ascending by key, no dict-order leak).
+- CARDINALITY: the ledger is LRU-capped (least recently WRITTEN
+  evicted first) so a tenant-id flood cannot grow host memory.
+- SHARED-LEDGER PARITY: MeshShardedPool's migration heuristic reads
+  its heat off the same HeatLedger type since PR18 — co-owning one
+  ledger with the attribution plane (int slot keys next to doc-name
+  strings) must leave the migration differential bit-exact.
+"""
+import random
+
+import jax
+
+from fluidframework_tpu.obs import metrics as obs_metrics
+from fluidframework_tpu.obs.heat import (
+    HeatLedger,
+    attribute_round,
+    usage_ledger,
+)
+
+
+class StepClock:
+    def __init__(self, step_s: float = 0.001):
+        self.t = 0.0
+        self.step_s = step_s
+
+    def __call__(self) -> float:
+        self.t += self.step_s
+        return self.t
+
+
+# ======================================================================
+# conservation
+
+
+def test_attribute_round_conserves_device_time():
+    """sum(per-doc charges) == round_ms for every round, and the
+    aggregate counter tracks the ledger total."""
+    rng = random.Random(7)
+    ledger = HeatLedger(clock=StepClock())
+    usage = usage_ledger(clock=StepClock())
+    counter = obs_metrics.REGISTRY.get("heat_doc_ms_total")
+    before = counter.value if counter is not None else 0.0
+    total_charged = 0.0
+    for _ in range(50):
+        counts = {
+            f"doc-{rng.randrange(12)}": rng.randrange(0, 9)
+            for _ in range(rng.randrange(1, 8))
+        }
+        round_ms = rng.random() * 20.0
+        pre = {d: ledger.get(d) for d in counts}
+        charged = attribute_round(
+            ledger, counts, round_ms,
+            usage=usage, tenant_of=lambda d: "t-" + d[-1])
+        real = sum(n for n in counts.values() if n > 0)
+        if real == 0:
+            assert charged == 0.0
+            continue
+        # the round total is conserved across its documents
+        deltas = [ledger.get(d) - pre[d] for d in counts]
+        assert abs(sum(deltas) - round_ms) <= 1e-9 * max(1.0, round_ms)
+        assert abs(charged - round_ms) <= 1e-9 * max(1.0, round_ms)
+        # proportionality: a doc's share is n/real of the round
+        for d, n in counts.items():
+            want = round_ms * n / real if n > 0 else 0.0
+            assert abs((ledger.get(d) - pre[d]) - want) <= 1e-9 * 20.0
+        total_charged += charged
+    # the aggregate counter is the same sum, counted as it happened
+    assert counter is not None
+    assert abs((counter.value - before) - total_charged) <= 1e-6
+    # and the tenant rollup conserves the same total
+    tenant_ms = sum(usage.column(t, "device_ms")
+                    for t in usage.keys())
+    assert abs(tenant_ms - total_charged) <= 1e-6
+
+
+def test_attribute_round_degenerate_rounds_charge_nothing():
+    ledger = HeatLedger(clock=StepClock())
+    assert attribute_round(None, {"d": 3}, 5.0) == 0.0
+    assert attribute_round(ledger, {"d": 3}, 0.0) == 0.0
+    assert attribute_round(ledger, {}, 5.0) == 0.0
+    assert attribute_round(ledger, {"d": 0}, 5.0) == 0.0
+    assert len(ledger) == 0
+
+
+# ======================================================================
+# determinism
+
+
+def _scripted_run(seed: int) -> HeatLedger:
+    rng = random.Random(seed)
+    ledger = usage_ledger(max_keys=64, clock=StepClock())
+    keys = [f"tenant-{i}" for i in range(20)]
+    for step in range(200):
+        k = rng.choice(keys)
+        ledger.charge(k, rng.random() * 4.0,
+                      ops_offered=rng.randrange(1, 5),
+                      bytes_in=float(rng.randrange(0, 512)))
+        if step % 17 == 0:
+            # EWMA tick over a random sub-population
+            pop = rng.sample(keys, 5)
+            ledger.ewma_tick(
+                {k: 0 for k in pop if k in ledger},
+                {k: rng.random() * 8.0 for k in pop},
+                decay=0.8)
+    return ledger
+
+
+def test_heat_ledger_is_bit_deterministic_x2():
+    """Same scripted sequence twice: bit-identical snapshot, top-k,
+    and key order (the LRU order is part of the contract)."""
+    a, b = _scripted_run(3), _scripted_run(3)
+    assert a.snapshot() == b.snapshot()
+    assert a.keys() == b.keys()
+    for by in (None, "ops_offered", "bytes_in"):
+        assert a.top_k(10, by=by) == b.top_k(10, by=by)
+
+
+def test_top_k_tie_break_is_ascending_by_key():
+    ledger = HeatLedger(clock=StepClock())
+    # insert in an order that would expose dict/insertion leaks
+    for k in ("z", "a", "m", "b"):
+        ledger.charge(k, 2.0)
+    ledger.charge("m", 1.0)
+    assert ledger.top_k(4) == [
+        ("m", 3.0), ("a", 2.0), ("b", 2.0), ("z", 2.0)]
+    assert ledger.top_k(2) == [("m", 3.0), ("a", 2.0)]
+
+
+# ======================================================================
+# cardinality
+
+
+def test_ledger_lru_cap_evicts_least_recently_written():
+    counter = obs_metrics.REGISTRY.get("heat_ledger_evictions_total")
+    before = counter.value if counter is not None else 0.0
+    ledger = HeatLedger(max_keys=4, clock=StepClock())
+    for i in range(4):
+        ledger.charge(f"k{i}", 1.0)
+    ledger.charge("k0", 1.0)          # k0 becomes most recent
+    ledger.charge("flood-1", 1.0)     # evicts k1 (oldest write)
+    ledger.charge("flood-2", 1.0)     # evicts k2
+    assert len(ledger) == 4
+    assert "k1" not in ledger and "k2" not in ledger
+    assert "k0" in ledger and "k3" in ledger
+    assert ledger.evictions == 2
+    assert counter is not None
+    assert counter.value - before == 2.0
+
+
+def test_usage_ledger_survives_tenant_flood_bounded():
+    ledger = usage_ledger(max_keys=32, clock=StepClock())
+    for i in range(10_000):
+        ledger.charge(f"tenant-{i}", 0.001, ops_offered=1)
+    assert len(ledger) == 32
+    assert ledger.evictions == 10_000 - 32
+
+
+# ======================================================================
+# shared-ledger mesh-pool parity (the PR8 migration differential,
+# re-pinned with the pool's heat co-owned by the attribution plane)
+
+
+def _hotspot_sidecars():
+    from fluidframework_tpu.parallel import MeshShardedPool, make_mesh
+    from fluidframework_tpu.service import TpuMergeSidecar
+
+    # the co-owned ledger: the mesh pool's migration heat (int slot
+    # keys) and the sidecar attribution plane (doc-name string keys)
+    # live on ONE ledger, like a serving deployment sharing the
+    # federation surface
+    shared = HeatLedger(max_keys=1 << 16, decay=0.5,
+                        clock=StepClock())
+    shared_sc = TpuMergeSidecar(
+        max_docs=6, capacity=16, max_capacity=16,
+        seq_mesh=make_mesh(jax.devices()[:2]), pool_capacity=256,
+        heat=shared, attr_clock=StepClock(),
+    )
+    assert isinstance(shared_sc._pool, MeshShardedPool)
+    shared_sc._pool.heat = shared    # co-own (pool is still empty)
+    plain_sc = TpuMergeSidecar(
+        max_docs=6, capacity=16, max_capacity=16,
+        seq_mesh=make_mesh(jax.devices()[:2]), pool_capacity=256,
+    )
+    return shared, shared_sc, plain_sc
+
+
+def test_mesh_pool_parity_on_shared_attribution_ledger():
+    """The hot-spot migration run with the pool's heat tracker on a
+    ledger CO-OWNED with the attribution plane must stay bit-exact
+    against the private-ledger pool: same migrations, same text,
+    same signatures — and the attribution keys must not perturb the
+    migration heuristic (nor vice versa)."""
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.service import LocalServer
+
+    server = LocalServer()
+    shared, shared_sc, plain_sc = _hotspot_sidecars()
+    sidecars = [shared_sc, plain_sc]
+    factory = LocalDocumentServiceFactory(server)
+    docs, containers, strings = [], {}, {}
+    for i in range(3):
+        doc = f"doc-{i}"
+        for sc in sidecars:
+            sc.subscribe(server, doc, "d", "s")
+        c = Container.load(factory.create_document_service(doc),
+                           client_id=f"{doc}-w")
+        s = c.runtime.create_datastore("d").create_channel(
+            "sharedstring", "s")
+        docs.append(doc)
+        containers[doc], strings[doc] = c, s
+
+    def grow(c, s, n_chunks=20):
+        for i in range(n_chunks):
+            s.insert_text(0, "abcdefgh")
+            c.flush()
+            if i % 3 == 2 and s.get_length() > 6:
+                s.remove_text(2, 5)
+                c.flush()
+
+    for doc in docs:
+        grow(containers[doc], strings[doc])
+    for sc in sidecars:
+        sc.apply()
+        sc.sync()
+    # hot-spot doc-0 until the mesh pools migrate
+    for _ in range(6):
+        for doc in docs:
+            n = 12 if doc == "doc-0" else 1
+            for _ in range(n):
+                strings[doc].insert_text(0, "XY")
+            containers[doc].flush()
+        for sc in sidecars:
+            sc.apply()
+            sc.sync()
+
+    assert shared_sc._pool.migration_count > 0, (
+        "the hot-spot run must actually migrate")
+    assert shared_sc._pool.migration_count == \
+        plain_sc._pool.migration_count
+    for doc in docs:
+        want = strings[doc].get_text()
+        assert shared_sc.text(doc, "d", "s") == want
+        assert plain_sc.text(doc, "d", "s") == want
+        assert shared_sc.signature(doc, "d", "s") == \
+            plain_sc.signature(doc, "d", "s")
+    # both planes actually wrote the shared ledger: int slot keys
+    # (pool heat) next to doc-name strings (attribution), and the
+    # attribution side conserved the doc plane's charges
+    keys = shared.keys()
+    assert any(isinstance(k, int) for k in keys)
+    assert any(isinstance(k, str) for k in keys)
+    attributed = sum(shared.get(d) for d in docs)
+    assert attributed > 0.0
+    # mixed key population still serves a deterministic top-k
+    assert shared.top_k(5) == shared.top_k(5)

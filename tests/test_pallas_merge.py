@@ -50,7 +50,10 @@ def _pallas_interpret(table, batch):
     return state_to_table(out, SegmentTable)
 
 
-@pytest.mark.parametrize("seed", [0, 7, 99])
+@pytest.mark.parametrize("seed", [
+    pytest.param(0, marks=pytest.mark.slow), 7,
+    pytest.param(99, marks=pytest.mark.slow),
+])
 def test_pallas_interpret_matches_xla(seed):
     docs, cap = 4, 128
     batch = _fuzz_batch(docs, seed0=1000 + seed * 10, steps=30)

@@ -17,6 +17,8 @@ the same recovery absorbs).
 """
 import random
 
+import pytest
+
 from fluidframework_tpu.drivers import LocalDocumentServiceFactory
 from fluidframework_tpu.loader import Container
 from fluidframework_tpu.service import LocalServer, TpuMergeSidecar
@@ -57,6 +59,7 @@ def _assert_parity(sidecars, docs, oracle=None):
                 f"all routes diverged from the oracle on {doc}")
 
 
+@pytest.mark.slow
 def test_routes_agree_on_steady_multidoc_traffic():
     rng = random.Random(7)
     server = LocalServer()
@@ -92,6 +95,7 @@ def test_routes_agree_on_steady_multidoc_traffic():
         assert not sidecars[route].overflowed(), route
 
 
+@pytest.mark.slow
 def test_routes_agree_through_grow_ladder():
     """Windows big enough to overflow a 16-slot slab force the regrow
     path — where the chunked route's overflow PARKING differs from the

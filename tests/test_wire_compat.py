@@ -287,7 +287,7 @@ def test_columnar_batch_roundtrips_live(alfred):
     got = []
     try:
         conn = svc.connect_to_delta_stream("colclient", got.append)
-        assert svc.agreed_version == "1.3"
+        assert svc.agreed_version == "1.4"
         sent = _capture_sends(svc)
         for op in _columnar_batch(["col", "umn", "ar"]):
             conn.submit(op)
@@ -401,6 +401,61 @@ def test_columnar_requires_wire_13():
         server._dispatch(session, {
             "type": "submitOp", "document_id": "enf13", "cols": cols,
         }, 0)
+
+
+def test_heat_requires_wire_14():
+    """Same discipline for the cost-attribution scrape: a 1.3-agreed
+    connection sending a heat frame gets the loud version error, not
+    a silent accept (the 1.1 upload gate, re-pinned for 1.4)."""
+    server, session = _columnar_session("enf14", ["1.3"])
+    with pytest.raises(ValueError, match="wire version >= 1.4"):
+        server._dispatch(session, {"type": "heat", "rid": 1}, 0)
+
+
+def test_heat_unnegotiated_dump_connection_interops():
+    """A bare dump connection (no connect_document — what
+    ``--dump-heat`` opens) serves the heat frame like ``metrics``:
+    no negotiated session, no gate, empty cuts when no ledger is
+    attached — never a nack or error."""
+    from fluidframework_tpu.service.ingress import _ClientSession
+
+    server = AlfredServer()
+    session = _ClientSession(server, None)
+    server._sessions.add(session)
+    server._dispatch(session, {"type": "heat", "rid": 7, "k": 3}, 0)
+    frames = _session_frames(session)
+    assert [f["type"] for f in frames] == ["heat"]
+    assert frames[0]["rid"] == 7
+    assert frames[0]["docs"] == [] and frames[0]["tenants"] == []
+
+
+def test_pre_14_peer_never_sees_heat_vocabulary(alfred):
+    """Interop pin: a 1.3-and-below peer collaborates normally and is
+    never sent a heat frame (the vocabulary is request/response only
+    and version-gated) — no nack, no error, ops flow."""
+    server = alfred()
+    svc, c = _load(server.port, "pre14", "old13",
+                   versions=("1.3", "1.2", "1.1", "1.0"))
+    try:
+        assert svc.agreed_version == "1.3"
+        with svc.lock:
+            t = c.runtime.create_datastore("ds").create_channel(
+                "sharedstring", "t")
+            t.insert_text(0, "still 1.3")
+            c.flush()
+        assert _pump(svc, c)
+        with svc.lock:
+            assert t.get_text() == "still 1.3"
+            c.close()
+        # the flight recorder logs every received frame's type: no
+        # heat vocabulary, no nack, no error reached the 1.3 peer
+        seen = {f.get("type") for _, _, kind, f in svc.flight.events()
+                if kind == "recv"}
+        assert "heat" not in seen
+        assert "nack" not in seen and "error" not in seen
+        assert "op" in seen  # the pin is non-vacuous: traffic flowed
+    finally:
+        svc.close()
 
 
 def test_traced_batch_falls_back_to_rows_on_13():
@@ -762,6 +817,7 @@ _SAMPLES = {
     "chunk": 0, "total": 1, "handle": "h1", "version": "1.0",
     "text": "# gen\n", "metrics": lambda: {},
     "nodes": lambda: ["node0"], "report": lambda: {},
+    "docs": lambda: [], "tenants": lambda: [],
     # sequenced-message payload fields
     "clientId": "gen", "sequenceNumber": 1,
     "minimumSequenceNumber": 0, "clientSequenceNumber": 1,
@@ -1011,6 +1067,13 @@ def _route_slo(frame, floor, monkeypatch):
     assert dump_slo("127.0.0.1:1") == 0
 
 
+def _route_heat(frame, floor, monkeypatch):
+    from fluidframework_tpu.service.__main__ import dump_heat
+
+    _patch_dump_transport(frame, monkeypatch)
+    assert dump_heat("127.0.0.1:1") == 0
+
+
 def _route_sequenced_payload(frame, floor, monkeypatch):
     from fluidframework_tpu.protocol.serialization import (
         message_from_json,
@@ -1064,6 +1127,7 @@ _GEN_ROUTES = {
     "metrics": _route_metrics,
     "fleet-metrics": _route_fleet,
     "slo": _route_slo,
+    "heat": _route_heat,
     "msg:sequenced": _route_sequenced_payload,
     "msg:document": _route_document_payload,
     "cols:columnar": _route_columnar_payload,

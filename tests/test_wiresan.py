@@ -220,6 +220,9 @@ def _drive_live_vocabulary(alfred):
         assert svc._request(
             {"type": "fleet-metrics"})["type"] == "fleet-metrics"
         assert svc._request({"type": "slo"})["type"] == "slo"
+        heat = svc._request({"type": "heat"})
+        assert heat["type"] == "heat"
+        assert "docs" in heat and "tenants" in heat
         with svc.lock:
             c.close()
     finally:
@@ -240,7 +243,7 @@ def _drive_live_vocabulary(alfred):
     got = []
     try:
         conn = svc2.connect_to_delta_stream("colclient", got.append)
-        assert svc2.agreed_version == "1.3"
+        assert svc2.agreed_version == "1.4"
         marks = [mark_batch(None, True), mark_batch(None, False)]
         for i, text in enumerate(("co", "ls")):
             conn.submit(DocumentMessage(
