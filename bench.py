@@ -43,7 +43,7 @@ import time
 STAGES = ("probe", "fuzz", "config1", "config2", "config3", "config4",
           "config5", "config6", "config7", "config8", "config9",
           "config10", "config11", "config12", "config13", "config14",
-          "config15", "config16")
+          "config15", "config16", "config17")
 
 # Machine-readable corpus identity, stamped into EVERY stage record
 # (r5 silently changed the stream mix — flow-mix quarter joined — and
@@ -58,7 +58,9 @@ STAGE_CORPUS = {
     "config1": STREAM_CORPUS,
     "config2": STREAM_CORPUS,
     "config3": {"generator": "matrix-synthetic", "version": 1},
-    "config4": {"generator": "tree-fuzz", "version": 1},
+    "config4": {"generator": "tree-fuzz", "version": 2,
+                "changed": "r7: moves joined the corpus (peer AND "
+                           "trunk changesets; tree serving plane)"},
     "config5": STREAM_CORPUS,
     "config6": {"generator": "ladder-typing", "version": 1},
     "config7": STREAM_CORPUS,
@@ -73,6 +75,7 @@ STAGE_CORPUS = {
                             "(event-splitting evidence)"},
     "config15": {"generator": "columnar-pack-mix", "version": 1},
     "config16": {"generator": "heat-attribution", "version": 1},
+    "config17": {"generator": "tree-serve", "version": 1},
 }
 
 
@@ -880,6 +883,7 @@ def stage_config4(scale: str, reps: int, cooldown: float) -> dict:
     tree rebases one peer changeset over a K-deep trunk suffix in a
     single batched dispatch (the EditManager sequenced path's hot
     loop)."""
+    import copy
     import random
 
     import jax
@@ -908,16 +912,16 @@ def stage_config4(scale: str, reps: int, cooldown: float) -> dict:
     base = [{"type": "n", "value": i} for i in range(base_n)]
     cases = []
     for _ in range(docs):
-        c_marks = random_changeset(rng, base_n, edits)
-        overs, cur = random_trunk(rng, base, k_trunk, edits)
+        c_marks = random_changeset(rng, base_n, edits, move_p=0.35)
+        overs, cur = random_trunk(rng, base, k_trunk, edits,
+                                  move_p=0.35)
         cases.append((c_marks, overs, cur))
 
     c_stack = stack_changesets(
         [encode_changeset(c)[0] for c, _, _ in cases])
     trunk = TreeAtoms(*[
         np.stack([
-            np.stack([encode_changeset(o, allow_moves=False)[0][f]
-                      for o in overs])
+            np.stack([encode_changeset(o)[0][f] for o in overs])
             for _, overs, _ in cases
         ])
         for f in ("kind", "pos", "n", "muted", "pos2")
@@ -938,13 +942,18 @@ def stage_config4(scale: str, reps: int, cooldown: float) -> dict:
     rebases = docs * k_trunk
     kernel_ops_s = rebases / best
 
-    # parity: applied-state equality on sample docs
+    # parity: applied-state equality on sample docs (Forest-applied —
+    # a rebased move is a paired del+rev, which bare walk_apply has no
+    # repair store for)
+    from fluidframework_tpu.models.tree.forest import Forest
     for d in range(min(4, docs)):
         c_marks, overs, cur = cases[d]
         change = {"root": c_marks}
         for o in overs:
             change = cs.rebase(change, {"root": o})
-        expect = cs.walk_apply(cur, change.get("root", []))
+        fexp = Forest({"root": copy.deepcopy(cur)})
+        fexp.apply(change, ("expect", d))
+        expect = fexp.content().get("root", [])
         out_np = {f: np.asarray(getattr(out, f))[d]
                   for f in out._fields}
         content = encode_changeset(c_marks)[1]
@@ -975,6 +984,10 @@ def stage_config4(scale: str, reps: int, cooldown: float) -> dict:
         **_dist(times),
         "parity": "applied-state-verified x4",
         "unit": "rebases/s",
+        # rebase_over_trunk has exactly one executor shape (lax.scan
+        # over the trunk suffix); stamped for config14/config17-style
+        # record comparability, not because there is a choice here
+        "executor_route": "scan",
     }
 
 
@@ -2880,6 +2893,205 @@ def stage_config16(scale: str, reps: int, cooldown: float) -> dict:
     return record
 
 
+def stage_config17(scale: str, reps: int, cooldown: float) -> dict:
+    """Tree serving plane (service/tree_sidecar.py): SharedTree
+    documents served doc-parallel through the sidecar's pipelined
+    pack -> dispatch -> settle loop.
+
+    Corpus: REAL service traffic — per document, concurrent writer
+    containers author move-bearing changesets (testing/tree_fuzz's
+    shared generator) through LocalServer's total order, and the
+    captured sequenced streams are replayed into fresh TreeSidecars,
+    the identical ingest feed a live subscription delivers.
+
+    Differentials BEFORE timing, per executor route:
+      - the served signature equals the scalar EditManager oracle on
+        EVERY document (service-level end state, not kernel-level)
+      - no document fell off the device path (host_mode_docs == 0)
+      - non-smoke: the capacity grow ladder was exercised
+
+    Metric: sequenced tree commits applied per second through the
+    full ingest + dispatch + settle loop, per route, vs the scalar
+    EditManager replaying the identical streams (vs_python).
+    """
+    import copy
+    import random
+
+    from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.models.tree.editmanager import (
+        Commit,
+        EditManager,
+    )
+    from fluidframework_tpu.ops.tree_apply import TREE_EXECUTOR_ROUTES
+    from fluidframework_tpu.protocol.messages import MessageType
+    from fluidframework_tpu.protocol.tree_payload import (
+        tree_change_from_json,
+    )
+    from fluidframework_tpu.service import LocalServer, TreeSidecar
+    from fluidframework_tpu.service.tree_sidecar import (
+        default_tree_executor,
+    )
+    from fluidframework_tpu.testing.tree_fuzz import (
+        random_change_with_moves,
+    )
+
+    docs, rounds, writers = {
+        "full": (32, 24, 3),
+        "cpu": (12, 12, 3),
+        "smoke": (4, 6, 2),
+    }[scale]
+    rng = random.Random(1700)
+
+    # --- corpus: real dispatch-loop traffic, captured per doc -------
+    server = LocalServer()
+    factory = LocalDocumentServiceFactory(server)
+    streams: dict[str, list] = {}
+    for d in range(docs):
+        doc = f"tree{d}"
+        cap: list = []
+        server.get_orderer(doc).broadcaster.subscribe(
+            f"bench-capture/{doc}", cap.append)
+        streams[doc] = cap
+        c1 = Container.load(factory.create_document_service(doc),
+                            client_id=f"{doc}-w0")
+        t1 = c1.runtime.create_datastore("d").create_channel(
+            "sharedtree", "t")
+        c1.flush()
+        conts = [(c1, t1)]
+        for w in range(1, writers):
+            cw = Container.load(factory.create_document_service(doc),
+                                client_id=f"{doc}-w{w}")
+            conts.append(
+                (cw, cw.runtime.get_datastore("d").get_channel("t")))
+        for rnd in range(rounds):
+            # all writers author against the round-start state, THEN
+            # the flushes race in shuffled order: every round carries
+            # genuine concurrency for the device rebase to resolve
+            for i, (c, t) in enumerate(conts):
+                t.apply_changeset(random_change_with_moves(
+                    rng, t.get_field(("root",)),
+                    f"{doc}-r{rnd}w{i}"))
+            order = list(conts)
+            rng.shuffle(order)
+            for c, _ in order:
+                c.flush()
+    commits = docs * rounds * writers
+
+    def _changes_of(m):
+        env = m.contents if isinstance(m.contents, dict) else {}
+        if m.type != MessageType.OPERATION \
+                or env.get("kind", "op") != "op" \
+                or env.get("address") != "d" \
+                or env.get("channel") != "t":
+            return None
+        return tree_change_from_json(env.get("contents"))
+
+    def _sig(nodes) -> str:
+        return json.dumps({"root": nodes}, sort_keys=True,
+                          default=str)
+
+    def oracle_replay() -> dict:
+        sigs = {}
+        for doc, msgs in streams.items():
+            em = EditManager(session_id=f"oracle-{doc}")
+            for m in msgs:
+                changes = _changes_of(m)
+                if changes is None:
+                    continue
+                em.add_sequenced_change(Commit(
+                    m.client_id or "", m.sequence_number,
+                    m.reference_sequence_number,
+                    copy.deepcopy(changes)), False)
+            sigs[doc] = _sig(em.forest().content().get("root", []))
+        return sigs
+
+    def sidecar_replay(route: str):
+        sc = TreeSidecar(max_docs=docs, capacity=64,
+                         max_capacity=512, executor=route)
+        for doc in streams:
+            sc.track(doc, "d", "t")
+        sc.prewarm()
+        length = max(len(v) for v in streams.values())
+        t0 = time.perf_counter()
+        for i in range(length):
+            for doc, msgs in streams.items():
+                if i < len(msgs):
+                    sc.ingest(doc, msgs[i])
+            # one dispatch round per authored round, doc-parallel:
+            # docs x writers commits per packed window
+            if (i + 1) % writers == 0:
+                sc.apply()
+        sc.apply()
+        sc.sync()
+        return sc, time.perf_counter() - t0
+
+    # --- parity BEFORE timing ---------------------------------------
+    expect = oracle_replay()
+    grow_counts = {}
+    for route in TREE_EXECUTOR_ROUTES:
+        sc, _ = sidecar_replay(route)
+        for doc in streams:
+            got = sc.signature(doc, "d", "t")
+            assert got == expect[doc], (
+                f"config17 parity FAILED: route {route} diverged "
+                f"from the scalar oracle on {doc}"
+            )
+        assert sc.host_mode_docs() == 0, (
+            f"config17 vacuous on route {route}: "
+            f"{sc.host_mode_docs()} doc(s) evicted off the device"
+        )
+        if scale != "smoke":
+            assert sc.grow_count >= 1, (
+                f"config17: route {route} never exercised the "
+                "capacity grow ladder"
+            )
+        grow_counts[route] = sc.grow_count
+
+    # --- timing ------------------------------------------------------
+    n_reps = max(2, reps)
+
+    def best_of(fn) -> float:
+        best = None
+        for _ in range(n_reps):
+            time.sleep(min(cooldown, 0.2))
+            t0 = time.perf_counter()
+            fn()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    py_s = best_of(oracle_replay)
+    route_s = {}
+    for route in TREE_EXECUTOR_ROUTES:
+        best = None
+        for _ in range(n_reps):
+            time.sleep(min(cooldown, 0.2))
+            _, dt = sidecar_replay(route)
+            best = dt if best is None else min(best, dt)
+        route_s[route] = best
+
+    default_route = default_tree_executor()
+    kernel_s = route_s[default_route]
+    return {
+        "docs": docs, "rounds": rounds, "writers": writers,
+        "commits": commits,
+        "parity": "both routes == scalar EditManager oracle on every "
+                  "doc (captured real service streams); "
+                  "host_mode_docs == 0",
+        "grow_count": grow_counts,
+        "python_baseline_s": round(py_s, 4),
+        "python_ops_per_sec": round(commits / py_s, 1),
+        "route_ops_per_sec": {
+            r: round(commits / s, 1) for r, s in route_s.items()},
+        "kernel_ops_per_sec": round(commits / kernel_s, 1),
+        "vs_python": round(py_s / kernel_s, 2),
+        # comparability: the route a default-constructed TreeSidecar
+        # serves with — route_ops_per_sec carries the full table
+        "executor_route": default_route,
+    }
+
+
 STAGE_FNS = {
     "probe": stage_probe,
     "fuzz": stage_fuzz,
@@ -2899,6 +3111,7 @@ STAGE_FNS = {
     "config14": stage_config14,
     "config15": stage_config15,
     "config16": stage_config16,
+    "config17": stage_config17,
 }
 
 

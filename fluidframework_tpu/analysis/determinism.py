@@ -162,6 +162,12 @@ WALL_CLOCK_SINKS: dict[tuple[str, str], str] = {
     ("service/tpu_sidecar.py", "TpuMergeSidecar._settle"):
         "settle_ms histogram + sidecar:settle trace timestamp (obs "
         "only; never feeds ordering)",
+    ("service/tree_sidecar.py", "TreeSidecar.prewarm"):
+        "prewarm returns measured warmup wall seconds (obs only)",
+    ("service/tree_sidecar.py", "TreeSidecar._dispatch"):
+        "tree pack_ms histogram (obs only; never feeds ordering)",
+    ("service/tree_sidecar.py", "TreeSidecar._settle"):
+        "tree settle_ms histogram (obs only; never feeds ordering)",
     ("service/ingress.py", "AlfredServer._dispatch"):
         "dispatch_ms histogram measures wall latency (obs only)",
     ("service/ingress.py", "AlfredServer._handle_upload_chunk"):

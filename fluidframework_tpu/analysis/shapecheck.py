@@ -72,6 +72,12 @@ LADDER_SOURCES = (
     # reach the device only through the same BucketLadder bucketing
     ("ops/host_bridge.py", "lower_columns"),
     ("ops/segment_table.py", "make_table"),
+    # the tree plane's packer buckets window depth via the same
+    # BucketLadder; make_tree_table is the tree slab's make_table
+    # (serve-path capacities come from capacity_rungs / the pool's
+    # fixed per-doc capacity)
+    ("ops/tree_apply.py", "pack_tree_window"),
+    ("ops/tree_apply.py", "make_tree_table"),
 )
 
 # Reviewed per-call-site exceptions: (module, caller-qualname, donated
@@ -110,7 +116,7 @@ LADDERED_CALLS: dict[tuple[str, str, str], str] = {
 
 # Calls whose result is freshly allocated (never aliases argument
 # buffers): names passed INTO them are not donated when the result is.
-FRESH_CONSTRUCTORS = ("make_table",)
+FRESH_CONSTRUCTORS = ("make_table", "make_tree_table")
 
 # ---------------------------------------------------------------------------
 # prewarm-coverage registries
@@ -128,11 +134,21 @@ DISPATCH_ROOTS = {
         "TpuMergeSidecar._grow",
         "TpuMergeSidecar.apply",
     ),
+    "service/tree_sidecar.py": (
+        "TreeSidecar._dispatch",
+        "TreeSidecar._settle",
+        "TreeSidecar._recover",
+        "TreeSidecar._grow",
+        "TreeSidecar.apply",
+    ),
 }
 
 PREWARM_ROOTS = {
     "service/tpu_sidecar.py": (
         "TpuMergeSidecar.prewarm",
+    ),
+    "service/tree_sidecar.py": (
+        "TreeSidecar.prewarm",
     ),
 }
 
@@ -164,6 +180,21 @@ PREWARM_INDIRECT = {
     ("ops/host_bridge.py", "replay_chunked"): (
         ("service/tpu_sidecar.py", "SeqShardedPool._apply"),
         ("parallel/mesh_pool.py", "MeshShardedPool._apply"),
+    ),
+    # the tree plane's attribute-held pool, same edges as the merge
+    # sidecar's: settle-boundary dispatch, recovery admission, and
+    # the prewarm walk through _warm_pool
+    ("service/tree_sidecar.py", "TreeSidecar._settle"): (
+        ("service/tree_sidecar.py", "TreeSeqPool.dispatch_pending"),
+    ),
+    ("service/tree_sidecar.py", "TreeSidecar._recover"): (
+        ("service/tree_sidecar.py", "TreeSidecar._admit_to_pool"),
+    ),
+    ("service/tree_sidecar.py", "TreeSidecar._admit_to_pool"): (
+        ("service/tree_sidecar.py", "TreeSeqPool.admit"),
+    ),
+    ("service/tree_sidecar.py", "TreeSidecar._warm_pool"): (
+        ("service/tree_sidecar.py", "TreeSeqPool.prewarm"),
     ),
 }
 

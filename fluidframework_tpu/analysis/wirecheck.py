@@ -74,6 +74,7 @@ from .core import Finding, SourceFile
 WIRE_MODULES = (
     "protocol/serialization.py",
     "protocol/columnar.py",
+    "protocol/tree_payload.py",
     "drivers/socket_driver.py",
     "drivers/caching_driver.py",
     "service/ingress.py",
@@ -105,6 +106,13 @@ PAYLOAD_CODECS = {
         ("emit", "cols:columnar"),
     ("protocol/columnar.py", "decode_columns"):
         ("read", "cols:columnar"),
+    # the wire-1.5 sharedtree channel-op payload: one codec pair for
+    # the dict the runtime envelope carries two levels down a msg:*
+    # payload (the tree serving plane's ingest feed)
+    ("protocol/tree_payload.py", "tree_change_to_json"):
+        ("emit", "msg:tree"),
+    ("protocol/tree_payload.py", "tree_change_from_json"):
+        ("read", "msg:tree"),
 }
 
 # request frame type -> the response frame type a ``_request()`` call

@@ -89,8 +89,9 @@ _SITE_FRAME_IN = _CHAOS.site(
 # the agreed one in "connected"; see ingress.WIRE_VERSIONS for what
 # each version adds — 1.1 is the chunked summary-upload plane, 1.2 the
 # boxcarred batch submit, 1.3 the columnar SoA batch submit, 1.4 the
-# heat cost-attribution frame)
-WIRE_VERSIONS = ("1.4", "1.3", "1.2", "1.1", "1.0")
+# heat cost-attribution frame, 1.5 the registered sharedtree payload
+# vocabulary)
+WIRE_VERSIONS = ("1.5", "1.4", "1.3", "1.2", "1.1", "1.0")
 
 
 def build_connect_frame(document_id: str, client_id: str, mode: str,

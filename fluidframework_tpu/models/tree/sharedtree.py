@@ -22,6 +22,10 @@ import copy
 from typing import Any, Optional, Sequence
 
 from ...protocol.messages import SequencedMessage
+from ...protocol.tree_payload import (
+    tree_change_from_json,
+    tree_change_to_json,
+)
 from ...runtime.shared_object import SharedObject
 from ...utils.events import EventEmitter
 from . import changeset as cs
@@ -142,8 +146,7 @@ class SharedTree(SharedObject, EventEmitter):
             return
         composed, tag = self._em.squash_local(tags)
         self.submit_local_message(
-            {"type": "tree", "changes": composed},
-            metadata={"tag": tag},
+            tree_change_to_json(composed), metadata={"tag": tag},
         )
         self.emit("changed", local=True)
 
@@ -272,8 +275,7 @@ class SharedTree(SharedObject, EventEmitter):
             self._txn.append(tag)
         else:
             self.submit_local_message(
-                {"type": "tree", "changes": changes},
-                metadata={"tag": tag},
+                tree_change_to_json(changes), metadata={"tag": tag},
             )
         self.emit("changed", local=True)
 
@@ -298,12 +300,13 @@ class SharedTree(SharedObject, EventEmitter):
             self._schema = schema
             self.emit("schemaChanged", local=local)
             return
-        if not isinstance(op, dict) or op.get("type") != "tree":
+        changes = tree_change_from_json(op)
+        if changes is None:
             raise ValueError(f"unexpected tree op: {op!r}")
         commit = Commit(session_id=msg.client_id or "",
                         seq=msg.sequence_number,
                         ref_seq=msg.reference_sequence_number,
-                        changes=op["changes"])
+                        changes=changes)
         self._em.add_sequenced_change(commit, is_local=local)
         if msg.minimum_sequence_number > self._em.min_seq:
             self._em.advance_minimum_sequence_number(
@@ -321,8 +324,7 @@ class SharedTree(SharedObject, EventEmitter):
         tag = (metadata or {}).get("tag")
         for change, t in self._em.local_changes:
             if t == tag:
-                self.submit_local_message({"type": "tree",
-                                           "changes": change},
+                self.submit_local_message(tree_change_to_json(change),
                                           metadata={"tag": tag})
                 return
         # Unknown tag: the op was already sequenced; nothing to resend.

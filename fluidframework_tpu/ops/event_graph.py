@@ -113,13 +113,17 @@ EXECUTOR_ROUTES = ("scan", "chunked", "egwalker")
 EG_K = 16
 
 
-def validate_executor(route: Optional[str], source: str) -> None:
+def validate_executor(route: Optional[str], source: str,
+                      routes: tuple = EXECUTOR_ROUTES) -> None:
     """Loud-on-typo executor validation — the select_pool discipline:
-    an emergency route change must never silently not happen."""
-    if route is not None and route not in EXECUTOR_ROUTES:
+    an emergency route change must never silently not happen.
+    ``routes`` defaults to the merge plane's registry; the tree
+    serving plane validates against its own
+    (ops/tree_apply.TREE_EXECUTOR_ROUTES) through the same gate."""
+    if route is not None and route not in routes:
         raise ValueError(
             f"{source}={route!r}: expected one of "
-            f"{'|'.join(repr(r) for r in EXECUTOR_ROUTES)}"
+            f"{'|'.join(repr(r) for r in routes)}"
         )
 
 

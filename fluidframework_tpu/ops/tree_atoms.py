@@ -26,9 +26,13 @@ algebra's tombstones); they ride along as zero-length anchors.
 Device-inexpressible marks (unpaired rev, tomb inputs, nested
 ``fields``) raise ``ValueError`` — callers fall back to the scalar
 path, the same eviction discipline the merge sidecar uses. MOV is
-supported in the changeset BEING REBASED; a move in the rebased-OVER
-trunk stays host-path (its follow-the-move semantics are scalar-only
-for now).
+supported in both roles: in the changeset BEING REBASED (one atom
+carries the del+rev pair) and in the rebased-OVER trunk (the kernel
+models the over-move as a unit detach at ``pos`` plus a unit attach
+at ``pos2`` — tree_kernel._rebase_one). ``allow_moves=False``
+remains as a caller-chosen guard for paths that deliberately keep
+trunk moves scalar (it raises so the fallback is loud, never a
+silent semantic change).
 """
 from __future__ import annotations
 
@@ -64,10 +68,10 @@ def encode_changeset(marks: list, width: int = DEFAULT_ATOMS,
     """Mark list (one field) -> single-doc atom arrays + host content
     table (content[i] set for INS atoms, None otherwise).
 
-    ``allow_moves=False`` is for changesets used in the rebased-OVER
-    role: the kernel's rebase math does not yet model an over-move's
-    follow-the-move shifts, so such trunks must take the host path
-    (this raises, callers fall back)."""
+    ``allow_moves=False`` is a caller-chosen guard for paths that
+    keep trunk moves on the scalar path (the kernel itself models
+    over-moves since the tree serving plane — see
+    tree_kernel._rebase_one); it raises so the fallback stays loud."""
     kind = np.zeros(width, np.int32)
     pos = np.zeros(width, np.int32)
     n = np.zeros(width, np.int32)

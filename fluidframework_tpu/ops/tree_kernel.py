@@ -41,40 +41,57 @@ from .tree_atoms import (
 def _rebase_one(c: TreeAtoms, o: TreeAtoms) -> TreeAtoms:
     """Rebase one doc's changeset atoms over one doc's ``over`` atoms
     (shared input coordinates). MOV atoms in ``c`` carry a node target
-    (pos = source) AND an attach anchor (pos2 = destination); moves in
-    ``o`` are rejected at encode time (host path)."""
+    (pos = source) AND an attach anchor (pos2 = destination). MOV
+    atoms in ``o`` contribute BOTH halves of the scalar del+rev pair:
+    a unit detach at ``o.pos`` (mutes C atoms targeting the moved
+    node — the moved node's concurrent edits stay muted, exactly like
+    the scalar pass, whose move-rev never revisits tombs it just
+    created — and collapses later positions left) and a unit attach
+    at ``o.pos2`` (shifting positions at-or-after the destination)."""
     live_o = o.muted == 0
     o_ins = (o.kind == ATOM_INS) & live_o
     o_del = (o.kind == ATOM_DEL) & live_o
+    o_mov = (o.kind == ATOM_MOV) & live_o
+    # the detach half of an over-move acts exactly like a unit delete
+    o_det = o_del | o_mov
 
     cpos = c.pos[:, None]          # [A, 1]
     opos = o.pos[None, :]          # [1, A]
+    odst = o.pos2[None, :]         # [1, A] over-move attach anchors
     node_target = (
         (c.kind == ATOM_DEL) | (c.kind == ATOM_SET)
         | (c.kind == ATOM_MOV)
     ) & (c.muted == 0)
 
-    # O-insert widths shifting each C atom. Node targets shift when the
-    # insert lands at-or-before their node (an insert AT index p pushes
-    # node p right); attaches/anchors only for strictly-before (tied
-    # position: later-sequenced C keeps the left slot).
+    # O-attach widths shifting each C atom: inserts (width n at pos)
+    # and over-move reattaches (width 1 at pos2). Node targets shift
+    # when the attach lands at-or-before their node (an attach AT
+    # index p pushes node p right); attaches/anchors only for
+    # strictly-before (tied position: later-sequenced C keeps the
+    # left slot).
     at_or_before = opos <= cpos
     strictly_before = opos < cpos
     ins_applies = jnp.where(
         node_target[:, None], at_or_before, strictly_before
     ) & o_ins[None, :]
+    mov_att_applies = jnp.where(
+        node_target[:, None], odst <= cpos, odst < cpos
+    ) & o_mov[None, :]
     ins_shift = jnp.sum(
-        jnp.where(ins_applies, o.n[None, :], 0), axis=1
+        jnp.where(ins_applies, o.n[None, :], 0)
+        + mov_att_applies.astype(jnp.int32),
+        axis=1,
     )
 
-    # O unit-deletes strictly before each atom collapse positions left.
+    # O unit-detaches strictly before each atom collapse positions left.
     del_shift = jnp.sum(
-        (o_del[None, :] & strictly_before).astype(jnp.int32), axis=1
+        (o_det[None, :] & strictly_before).astype(jnp.int32), axis=1
     )
 
-    # target node deleted by O -> mute (the scalar algebra's
-    # tombstone; for MOV this is delete-wins: both halves mute)
-    hit = jnp.any(o_del[None, :] & (opos == cpos), axis=1)
+    # target node detached by O -> mute (the scalar algebra's
+    # tombstone; for C-MOV this is delete-wins: one atom is both
+    # halves, so muting it kills detach and reattach together)
+    hit = jnp.any(o_det[None, :] & (opos == cpos), axis=1)
     muted = jnp.where(node_target & hit, 1, c.muted)
 
     pos = jnp.where(
@@ -82,14 +99,15 @@ def _rebase_one(c: TreeAtoms, o: TreeAtoms) -> TreeAtoms:
     )
 
     # the MOV destination anchor rebases like an attach (strictly-
-    # before inserts shift it; earlier deletes collapse it left)
+    # before attaches shift it; earlier detaches collapse it left)
     cdst = c.pos2[:, None]
     dst_ins_shift = jnp.sum(
-        jnp.where((opos < cdst) & o_ins[None, :], o.n[None, :], 0),
+        jnp.where((opos < cdst) & o_ins[None, :], o.n[None, :], 0)
+        + ((odst < cdst) & o_mov[None, :]).astype(jnp.int32),
         axis=1,
     )
     dst_del_shift = jnp.sum(
-        (o_del[None, :] & (opos < cdst)).astype(jnp.int32), axis=1
+        (o_det[None, :] & (opos < cdst)).astype(jnp.int32), axis=1
     )
     pos2 = jnp.where(
         c.kind == ATOM_MOV,

@@ -222,6 +222,15 @@ WIRE_SCHEMA = {
         "text_off": "1.3",
         "text": "1.3",
     },
+    # the sharedtree channel-op payload (wire 1.5, the tree serving
+    # plane): rides the runtime envelope two levels below a msg:*
+    # payload ("contents" of the envelope riding "contents").
+    # protocol/tree_payload.py is the one codec; "changes" is the
+    # FieldChanges changeset vocabulary of models/tree/changeset.py.
+    "msg:tree": {
+        "type": "1.5",
+        "changes": "1.5",
+    },
 }
 
 

@@ -1,0 +1,53 @@
+"""The sharedtree channel-op payload codec (wire 1.5).
+
+A SharedTree edit rides the runtime envelope two levels below a
+``msg:*`` payload (``msg.contents.contents``) as
+``{"type": "tree", "changes": <FieldChanges>}`` — "changes" is the
+changeset JSON vocabulary of ``models/tree/changeset.py`` (marks with
+skip/ins/del/mod/mv, already plain JSON by construction). Until the
+tree serving plane, that dict was built ad hoc at three submit sites
+and picked apart at two ingest sites; this pair is now the ONE
+definition: ``models/tree/sharedtree.py`` emits through it, the
+sharedtree channel and ``service/tree_sidecar.py`` decode through it,
+wirecheck's ``msg:tree`` registry entry names its fields, and
+wiresan's payload descent walks them at runtime.
+
+Pure stdlib on purpose — the protocol layer stays importable without
+numpy (the columnar.py rule); FieldChanges stays an opaque JSON value
+here, its mark grammar belongs to the model layer.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+__all__ = [
+    "TREE_OP_TYPE",
+    "tree_change_to_json",
+    "tree_change_from_json",
+]
+
+# the payload discriminator value, as a named constant: "tree-schema"
+# ops (stored-schema evolution) share the channel but NOT this codec
+TREE_OP_TYPE = "tree"
+
+
+def tree_change_to_json(changes: Any) -> dict:
+    """Wrap one FieldChanges changeset as the wire payload dict."""
+    return {"type": TREE_OP_TYPE, "changes": changes}
+
+
+def tree_change_from_json(payload: Any) -> Optional[Any]:
+    """The changeset carried by a channel-op payload, or None when the
+    payload is not a tree edit (tree-schema ops, foreign channels,
+    compressed blobs) — callers route on None instead of re-checking
+    the discriminator. A tree-typed payload with no changeset is
+    malformed, not foreign: that raises."""
+    if not isinstance(payload, dict) or \
+            payload.get("type") != TREE_OP_TYPE:
+        return None
+    changes = payload.get("changes")
+    if changes is None:
+        raise ValueError(
+            "tree payload carries no 'changes' changeset"
+        )
+    return changes

@@ -26,6 +26,7 @@ from .partitioning import (
 from .sequencer import DocumentSequencer, TicketResult
 from .tenancy import AuthError, Tenant, TenantManager, sign_token
 from .tpu_sidecar import TpuMergeSidecar
+from .tree_sidecar import ChannelKindRouter, TreeSeqPool, TreeSidecar
 
 __all__ = [
     "AlfredServer",
@@ -54,6 +55,9 @@ __all__ = [
     "SummaryStore",
     "TicketResult",
     "TpuMergeSidecar",
+    "ChannelKindRouter",
+    "TreeSeqPool",
+    "TreeSidecar",
 ]
 
 

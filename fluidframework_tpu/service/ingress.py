@@ -181,7 +181,13 @@ _SITE_UPLOAD = _CHAOS.site("ingress.summary_upload", (KIND_ERROR,))
 #       NEGOTIATED <= 1.3 must not send it (server rejects loudly,
 #       same as the 1.1 upload gate); 1.0-1.3 peers see no heat
 #       frames and no behavior change.
-WIRE_VERSIONS = ("1.4", "1.3", "1.2", "1.1", "1.0")
+# 1.5 — registers the sharedtree channel-op payload ("msg:tree",
+#       protocol/tree_payload.py, the tree serving plane). Pure
+#       vocabulary: the payload rode opaque envelope contents
+#       before, so no frame changes, no gate, and no byte changes
+#       for any peer — 1.5 puts its fields under the wirecheck /
+#       wiresan / golden-snapshot review regime.
+WIRE_VERSIONS = ("1.5", "1.4", "1.3", "1.2", "1.1", "1.0")
 
 
 def document_message_to_json(op: DocumentMessage) -> dict:

@@ -493,9 +493,15 @@ class SeqShardedPool:
 def select_pool(mesh, per_doc_capacity: Optional[int] = None,
                 executor: Optional[str] = None,
                 route: Optional[str] = None,
-                max_capacity: int = 16384):
-    """THE route-selection point between the two pool tiers — every
-    sidecar pool is constructed here, nowhere else.
+                max_capacity: int = 16384,
+                plane: str = "merge"):
+    """THE route-selection point between the pool tiers — every
+    sidecar pool (merge AND tree plane) is constructed here, nowhere
+    else. ``plane='tree'`` admits tree documents to the pooled tier
+    (``TreeSeqPool``): the tree kernels' per-changeset sorts do not
+    decompose over a slot-sharded axis, so that pool's capacity
+    unlock is a larger chip-local slab and the merge-plane
+    ``route`` knob does not apply.
 
     - a mesh with a real ``seq`` axis (size > 1) -> ``SeqShardedPool``
       (one long document's SLOT axis split across devices);
@@ -516,6 +522,20 @@ def select_pool(mesh, per_doc_capacity: Optional[int] = None,
     ladder top by its seq-shard count (per-doc capacity is the point
     of slot sharding); the mesh pool grants 4x the ladder top (its
     capacity unlock is MEMBER COUNT — per-doc stays chip-local)."""
+    if plane not in ("merge", "tree"):
+        raise ValueError(
+            f"plane={plane!r}: expected 'merge' or 'tree'")
+    if plane == "tree":
+        from .tree_sidecar import TreeSeqPool
+
+        # executor validation happens against the TREE route registry
+        # inside TreeSeqPool (the merge routes don't apply here)
+        return TreeSeqPool(
+            mesh,
+            per_doc_capacity if per_doc_capacity is not None
+            else min(max_capacity * 4, 16384),
+            executor=executor,
+        )
     source = "pool_route"
     validate_executor(executor, "executor")
     if route is None:

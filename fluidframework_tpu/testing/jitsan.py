@@ -86,6 +86,9 @@ _JIT_ATTRS = {
         "fluidframework_tpu.ops.shard_moves", "_take_rows_jit"),
     "mesh_move_pingpong": (
         "fluidframework_tpu.ops.shard_moves", "_migrate_rows_donating"),
+    # the tree serving plane's capacity-ladder pad step
+    "tree_pad": (
+        "fluidframework_tpu.ops.tree_apply", "pad_tree_capacity"),
 }
 
 # factory caches of jit objects (dict -> jit): root -> (module, attr)
@@ -102,6 +105,10 @@ _JIT_CACHES = {
         "fluidframework_tpu.parallel.seq_shard", "_compiled_cache"),
     "mesh_pool": (
         "fluidframework_tpu.parallel.mesh_pool", "_compiled_cache"),
+    # the tree serving plane's window root (both tree routes share
+    # one route-keyed cache of jitted window programs)
+    "tree_window": (
+        "fluidframework_tpu.ops.tree_apply", "_jit_cache"),
 }
 
 ROOTS = tuple(sorted((*_JIT_ATTRS, *_JIT_CACHES)))

@@ -7,15 +7,34 @@ Mirrors the reference's rebase-law fuzz pattern
 """
 from __future__ import annotations
 
+import copy
 import random
 
 from ..models.tree import changeset as cs
+from ..models.tree.forest import Forest
 
 
 def random_changeset(rng: random.Random, base_len: int,
-                     n_edits: int = 3) -> list:
+                     n_edits: int = 3, move_p: float = 0.0) -> list:
     """Random ins/del/mod mark list against a base of ``base_len``
-    nodes — the device-expressible subset (tree_atoms.py)."""
+    nodes — the device-expressible subset (tree_atoms.py).
+
+    ``move_p``: probability of emitting a standalone MOVE changeset
+    (paired detach+revive, ``changeset.move``) instead of the
+    ins/del/mod mix — 0 keeps the historical corpus (generator
+    version 1); the move-racing workloads (test_tree_moves, bench
+    config4 v2, the tree serving plane's fuzz) opt in."""
+    if move_p and base_len >= 2 and rng.random() < move_p:
+        src = rng.randint(0, base_len - 1)
+        count = rng.randint(1, min(2, base_len - src))
+        choices = [d for d in range(base_len + 1)
+                   if d <= src or d >= src + count]
+        dst = rng.choice(choices)
+        # stamped: a bare move's rev half carries an unresolved pair
+        # token — neither Forest.apply nor encode_changeset accepts it
+        change = {"root": cs.move(src, count, dst)}
+        cs.stamp(change, f"mv{rng.getrandbits(48)}")
+        return change["root"]
     marks = []
     remaining = base_len
     for _ in range(n_edits):
@@ -42,12 +61,78 @@ def random_changeset(rng: random.Random, base_len: int,
 
 
 def random_trunk(rng: random.Random, base: list, depth: int,
-                 n_edits: int = 3) -> tuple[list[list], list]:
+                 n_edits: int = 3,
+                 move_p: float = 0.0) -> tuple[list[list], list]:
     """``depth`` successive changesets, each authored against the
     previous one's output; returns (changesets, final_sequence)."""
     overs, cur = [], list(base)
+    if move_p:
+        # a move's rev half needs repair data, which bare walk_apply
+        # has no store for — advance through a Forest instead
+        f = Forest({"root": copy.deepcopy(list(base))})
+        for i in range(depth):
+            o = random_changeset(rng, len(cur), n_edits,
+                                 move_p=move_p)
+            overs.append(o)
+            f.apply({"root": o}, ("trunk", i))
+            cur = f.content().get("root", [])
+        return overs, cur
     for _ in range(depth):
-        o = random_changeset(rng, len(cur), n_edits)
+        o = random_changeset(rng, len(cur), n_edits, move_p=move_p)
         overs.append(o)
         cur = cs.walk_apply(cur, o)
     return overs, cur
+
+
+def random_change_with_moves(rng: random.Random, base_nodes: list,
+                             uid: str, n_edits: int = 3,
+                             move_p: float = 0.6):
+    """Random STAMPED FieldChanges over ins/del/mod/MOVE against
+    ``base_nodes`` — the shared generator behind the move-parity
+    suites (tests/test_tree_moves.py) and the tree serving plane's
+    concurrent fuzz, so the parity workload and the benchmark
+    workload can't drift apart. Moves are authored standalone (the
+    scalar ``changeset.move`` form: a paired detach+revive against
+    one base), everything else as a positioned mark list; ``mod``
+    values carry the true ``old`` for exact invertibility."""
+    base_len = len(base_nodes)
+    marks = []
+    remaining = base_len
+    pos = 0
+    for _ in range(n_edits):
+        if remaining <= 0:
+            break
+        gap = rng.randint(0, remaining - 1) if remaining > 1 else 0
+        if gap:
+            marks.append(cs.skip(gap))
+            remaining -= gap
+            pos += gap
+        roll = rng.random()
+        if roll < 0.3:
+            marks.append(cs.ins(
+                [{"type": "n", "value": 500 + i}
+                 for i in range(rng.randint(1, 2))]
+            ))
+        elif roll < 0.55 and remaining > 0:
+            k = rng.randint(1, min(2, remaining))
+            marks.append(cs.dele(k))
+            remaining -= k
+            pos += k
+        elif roll < 0.8 and remaining > 0:
+            marks.append(cs.mod(value={
+                "new": rng.randint(100, 199),
+                "old": base_nodes[pos].get("value"),
+            }))
+            remaining -= 1
+            pos += 1
+        else:
+            break  # moves are authored standalone below
+    change = cs.normalize_fields({"root": marks})
+    if rng.random() < move_p and base_len >= 2:
+        src = rng.randint(0, base_len - 1)
+        count = rng.randint(1, min(2, base_len - src))
+        choices = [d for d in range(base_len + 1)
+                   if d <= src or d >= src + count]
+        dst = rng.choice(choices)
+        change = {"root": cs.move(src, count, dst)}
+    return cs.stamp(change, uid)

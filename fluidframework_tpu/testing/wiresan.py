@@ -128,6 +128,18 @@ def _record_payload(value, ptype: str, seam: str) -> None:
     if not isinstance(value, dict):
         return
     _record_fields(ptype, value, seam, discriminator=False)
+    # tree channel-op descent (wire 1.5): the sharedtree payload
+    # rides the runtime envelope two levels down — msg contents hold
+    # {"kind": "op", ..., "contents": {"type": "tree", ...}}.
+    # Keyed strictly on the "tree" discriminator: tree-schema ops and
+    # foreign channels share the envelope but not the msg:tree schema
+    envelope = value.get("contents")
+    if isinstance(envelope, dict) and \
+            envelope.get("kind", "op") == "op":
+        leaf = envelope.get("contents")
+        if isinstance(leaf, dict) and leaf.get("type") == "tree":
+            _record_fields("msg:tree", leaf, seam,
+                           discriminator=False)
 
 
 def _record_fields(ftype: str, frame: dict, seam: str,

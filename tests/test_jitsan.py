@@ -833,6 +833,111 @@ def test_prewarm_covers_pool_admission_compiles(sanitizer):
     )
 
 
+def _drive_tree(server, sidecar, doc: str, n: int = 20):
+    """Frequent-flush tree writer traffic: small per-apply windows,
+    node count climbing past the first capacity rung (a regrow)."""
+    from fluidframework_tpu.models.tree import node
+
+    factory = LocalDocumentServiceFactory(server)
+    sidecar.subscribe(server, doc, "d", "t")
+    c = Container.load(factory.create_document_service(doc),
+                       client_id=f"{doc}-writer")
+    t = c.runtime.create_datastore("d").create_channel(
+        "sharedtree", "t")
+    for i in range(n):
+        t.insert_nodes(("root",), 0,
+                       [node("n", value=i * 4 + j) for j in range(4)])
+        c.flush()
+        if i % 3 == 2:
+            t.move_nodes(("root",), 0, 1, 3)
+            c.flush()
+        sidecar.apply()
+    sidecar.sync()
+    return c, t
+
+
+@pytest.fixture()
+def cold_tree_caches(monkeypatch):
+    """Fresh jit caches for the tree serving roots (the
+    ``cold_mesh_caches`` rule): a warm-cache run observes ZERO new
+    compiles at prewarm, failing the non-vacuity asserts below
+    depending on suite order. ``tree_sidecar`` binds
+    ``pad_tree_capacity`` by value at import, so the fresh pad jit is
+    patched in BOTH modules or the sidecar would keep dispatching the
+    warm original while jitsan probes the cold replacement."""
+    import fluidframework_tpu.ops.tree_apply as tree_apply
+    import fluidframework_tpu.service.tree_sidecar as tree_sidecar_mod
+
+    def _fresh_pad(table, new_slots):
+        return tree_apply._pad_tree_impl(table, new_slots)
+
+    fresh_pad = jax.jit(_fresh_pad, static_argnums=(1,))
+    monkeypatch.setattr(tree_apply, "_jit_cache", {})
+    monkeypatch.setattr(tree_apply, "pad_tree_capacity", fresh_pad)
+    monkeypatch.setattr(
+        tree_sidecar_mod, "pad_tree_capacity", fresh_pad)
+    jitsan.reset()  # baseline the fresh (empty) caches
+
+
+@pytest.mark.parametrize("route", ("atom", "macro"))
+def test_tree_prewarm_covers_serving_compiles(
+        sanitizer, cold_tree_caches, route):
+    """The tree serving plane's prewarm-coverage pin, per route:
+    after ``TreeSidecar.prewarm()`` (which walks the full
+    (capacity rung x window bucket x BOTH routes) ladder plus the
+    pad step), in-ladder tree traffic — including a grow recovery —
+    pays ZERO mid-serve compiles on either tree root."""
+    from fluidframework_tpu.service import TreeSidecar
+
+    ladder = BucketLadder(window_floor=16, max_bucket=32)
+    sidecar = TreeSidecar(max_docs=2, capacity=16, max_capacity=64,
+                          executor=route, ladder=ladder)
+    sidecar.prewarm()
+    counts = sanitizer.compile_counts()
+    # non-vacuity + ladder arithmetic: the window root holds at most
+    # one signature per (rung, bucket, route, input-commitment) —
+    # prewarm walks fresh AND dispatch-output tables — the pad root
+    # one per rung transition
+    rungs = len(BucketLadder.capacity_rungs(16, 64))
+    buckets = len(ladder.window_buckets())
+    assert 0 < counts["tree_window"] <= rungs * buckets * 2 * 2
+    assert 0 < counts["tree_pad"] <= max(rungs - 1, 1)
+    jitsan.reset()
+    server = LocalServer()
+    _drive_tree(server, sidecar, "doc")
+    assert sidecar.grow_count >= 1, "traffic must exercise a regrow"
+    counts = sanitizer.compile_counts()
+    assert all(n == 0 for n in counts.values()), (
+        f"mid-serve tree compiles after prewarm: "
+        f"{ {r: n for r, n in counts.items() if n} }"
+    )
+
+
+def test_tree_prewarm_covers_pool_admission_compiles(
+        sanitizer, cold_tree_caches):
+    """With a pool mesh attached, TreeSidecar.prewarm walks the pool
+    tier's first-admission programs too — the first mid-serve pool
+    admission and its incremental dispatches compile nothing."""
+    from fluidframework_tpu.parallel.seq_shard import make_seq_mesh
+    from fluidframework_tpu.service import TreeSidecar
+
+    mesh = make_seq_mesh(jax.devices()[:1], doc_shards=1)
+    sidecar = TreeSidecar(max_docs=2, capacity=16, max_capacity=16,
+                          executor="atom", pool_mesh=mesh,
+                          pool_capacity=64,
+                          ladder=BucketLadder(16, 16))
+    sidecar.prewarm()
+    jitsan.reset()
+    server = LocalServer()
+    _, t = _drive_tree(server, sidecar, "doc", n=8)
+    assert sidecar.pooled_docs() == 1, "traffic must exercise the pool"
+    counts = sanitizer.compile_counts()
+    assert all(n == 0 for n in counts.values()), (
+        f"mid-serve tree compiles after prewarm: "
+        f"{ {r: n for r, n in counts.items() if n} }"
+    )
+
+
 def test_publish_compiles_feeds_the_registry_counter(sanitizer):
     from fluidframework_tpu.ops.merge_kernel import compact
 

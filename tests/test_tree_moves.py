@@ -18,6 +18,7 @@ from fluidframework_tpu.models.tree import changeset as cs
 from fluidframework_tpu.models.tree import node
 from fluidframework_tpu.models.tree.forest import Forest
 from fluidframework_tpu.testing.runtime_mocks import ContainerSession
+from fluidframework_tpu.testing.tree_fuzz import random_change_with_moves
 
 
 def mk_nodes(n, base=0):
@@ -31,60 +32,17 @@ def applied(base, *changes_revs):
     return f.content()["root"]
 
 
-def rand_change_with_moves(rng, base_nodes, uid):
-    """Random mark list over ins/del/mod/MOVE, stamped."""
-    base_len = len(base_nodes)
-    marks = []
-    remaining = base_len
-    pos = 0
-    for _ in range(3):
-        if remaining <= 0:
-            break
-        gap = rng.randint(0, remaining - 1) if remaining > 1 else 0
-        if gap:
-            marks.append(cs.skip(gap))
-            remaining -= gap
-            pos += gap
-        roll = rng.random()
-        if roll < 0.3:
-            marks.append(cs.ins(mk_nodes(rng.randint(1, 2), 500)))
-        elif roll < 0.55 and remaining > 0:
-            k = rng.randint(1, min(2, remaining))
-            marks.append(cs.dele(k))
-            remaining -= k
-            pos += k
-        elif roll < 0.8 and remaining > 0:
-            marks.append(cs.mod(value={
-                "new": rng.randint(100, 199),
-                "old": base_nodes[pos].get("value"),
-            }))
-            remaining -= 1
-            pos += 1
-        else:
-            break  # moves are authored standalone below
-    change = cs.normalize_fields({"root": marks})
-    if rng.random() < 0.6 and base_len >= 2:
-        # standalone move changeset against the same base
-        src = rng.randint(0, base_len - 1)
-        count = rng.randint(1, min(2, base_len - src))
-        choices = [d for d in range(base_len + 1)
-                   if d <= src or d >= src + count]
-        dst = rng.choice(choices)
-        change = {"root": cs.move(src, count, dst)}
-    return cs.stamp(change, uid)
-
-
 @pytest.mark.parametrize("seed", range(40))
 def test_move_rebase_laws(seed):
     """rebase(a, compose(b, c)) == rebase(rebase(a, b), c) and the
     identity laws, with moves in all three changesets."""
     rng = random.Random(seed * 17 + 3)
     base = mk_nodes(6)
-    a = rand_change_with_moves(rng, base, f"A{seed}")
-    b = rand_change_with_moves(rng, base, f"B{seed}")
+    a = random_change_with_moves(rng, base, f"A{seed}")
+    b = random_change_with_moves(rng, base, f"B{seed}")
     fb = Forest({"root": [dict(x) for x in base]})
     fb.apply(b, "b")
-    c = rand_change_with_moves(
+    c = random_change_with_moves(
         rng, fb.content()["root"], f"C{seed}"
     )
     fb.apply(c, "c")  # fb now holds base+b+c WITH their repair data
@@ -106,7 +64,7 @@ def test_move_invert_roundtrip(seed):
     is the move back."""
     rng = random.Random(seed * 29 + 11)
     base = mk_nodes(6)
-    a = rand_change_with_moves(rng, base, f"A{seed}")
+    a = random_change_with_moves(rng, base, f"A{seed}")
     inv = cs.invert(a, f"inv{seed}")
     out = applied(base, (a, "a"), (inv, "inv"))
     assert out == base

@@ -287,7 +287,7 @@ def test_columnar_batch_roundtrips_live(alfred):
     got = []
     try:
         conn = svc.connect_to_delta_stream("colclient", got.append)
-        assert svc.agreed_version == "1.4"
+        assert svc.agreed_version == "1.5"
         sent = _capture_sends(svc)
         for op in _columnar_batch(["col", "umn", "ar"]):
             conn.submit(op)
@@ -839,6 +839,14 @@ _SAMPLE_OVERRIDES = {
     ("cols:columnar", "pos2"): lambda: [0],
     ("cols:columnar", "text_off"): lambda: [0, 3],
     ("cols:columnar", "text"): "gen",
+    # the sharedtree payload: "type" is the payload discriminator
+    # (generic _SAMPLES["type"] is the sequenced MessageType int) and
+    # "changes" a minimal one-insert FieldChanges changeset in the
+    # models/tree/changeset.py mark grammar
+    ("msg:tree", "type"): "tree",
+    ("msg:tree", "changes"): lambda: {
+        "root": [{"t": "ins", "content": [{"type": "n", "value": 1}]}],
+    },
     ("summary", "summary"): lambda: __import__(
         "fluidframework_tpu.protocol.serialization",
         fromlist=["encode_contents"]).encode_contents(
@@ -1108,6 +1116,26 @@ def _route_columnar_payload(frame, floor, monkeypatch):
     assert encode_columns(decoded) == frame
 
 
+def _route_tree_payload(frame, floor, monkeypatch):
+    from fluidframework_tpu.models.tree import changeset as cs
+    from fluidframework_tpu.protocol.tree_payload import (
+        tree_change_from_json,
+        tree_change_to_json,
+    )
+
+    changes = tree_change_from_json(frame)
+    assert changes is not None
+    # the sample changeset is well-formed model vocabulary, not just
+    # schema-shaped JSON: the scalar walk applies it
+    assert cs.walk_apply([], changes["root"]) == \
+        [{"type": "n", "value": 1}]
+    # the codec pair is a faithful round trip, and non-tree payloads
+    # (the stored-schema plane shares the channel) route to None
+    assert tree_change_to_json(changes) == frame
+    assert tree_change_from_json(
+        {"type": "tree-schema", "schema": {}}) is None
+
+
 _GEN_ROUTES = {
     "connect_document": _route_connect_document,
     "connected": _route_connected,
@@ -1131,6 +1159,7 @@ _GEN_ROUTES = {
     "msg:sequenced": _route_sequenced_payload,
     "msg:document": _route_document_payload,
     "cols:columnar": _route_columnar_payload,
+    "msg:tree": _route_tree_payload,
 }
 
 

@@ -187,9 +187,14 @@ def _drive_live_vocabulary(alfred):
     c.on("nack", nacks.append)
     try:
         with svc.lock:
-            t = c.runtime.create_datastore("ds").create_channel(
-                "sharedstring", "t")
+            ds = c.runtime.create_datastore("ds")
+            t = ds.create_channel("sharedstring", "t")
             t.insert_text(0, "wire")
+            # the wire-1.5 sharedtree payload: one tree edit puts
+            # msg:tree on the wire (wiresan's two-level descent)
+            tree = ds.create_channel("sharedtree", "tr")
+            tree.insert_nodes(("root",), 0,
+                              [{"type": "n", "value": 1}])
             c.flush()
         # burn the per-connection op burst until a throttle nack lands
         deadline = time.time() + 10.0
@@ -243,7 +248,7 @@ def _drive_live_vocabulary(alfred):
     got = []
     try:
         conn = svc2.connect_to_delta_stream("colclient", got.append)
-        assert svc2.agreed_version == "1.4"
+        assert svc2.agreed_version == "1.5"
         marks = [mark_batch(None, True), mark_batch(None, False)]
         for i, text in enumerate(("co", "ls")):
             conn.submit(DocumentMessage(
