@@ -2080,11 +2080,28 @@ def stage_config11(scale: str, reps: int, cooldown: float) -> dict:
     }[scale]
 
     # --- storm leg: goodput dip + recovery time ----------------------
-    t0 = time.perf_counter()
-    storm_rep = run_chaos_storm(seed=11, steps=steps, storm=storm)
-    storm_wall = time.perf_counter() - t0
+    # failsan rides the storm: every injected fault must map to an
+    # observable signal (docs/ROBUSTNESS.md fault-to-signal
+    # accounting) — a silent absorb fails the bench round by site
+    from fluidframework_tpu.testing import failsan
+
+    failsan.install()
+    try:
+        failsan.reset()
+        t0 = time.perf_counter()
+        storm_rep = run_chaos_storm(seed=11, steps=steps, storm=storm)
+        storm_wall = time.perf_counter() - t0
+        failsan.flush()
+        fail_trips = failsan.trips()
+        signal_coverage = failsan.signal_coverage()
+    finally:
+        failsan.reset()
+        failsan.uninstall()
     assert storm_rep.converged, (
         f"config11 storm diverged: {storm_rep.failures}")
+    assert not fail_trips and signal_coverage == 1.0, (
+        "config11 fault-to-signal accounting failed:\n"
+        + "\n".join(t.describe() for t in fail_trips))
     # run-to-run determinism on the step clock (config9 discipline)
     again = run_chaos_storm(seed=11, steps=steps, storm=storm)
     assert again.deterministic_fields() == \
@@ -2132,6 +2149,7 @@ def stage_config11(scale: str, reps: int, cooldown: float) -> dict:
         "recovery_time_s": storm_rep.recovery_time_s,
         "faults_fired": storm_rep.fired,
         "chaos_counts": storm_rep.chaos_counts,
+        "signal_coverage": signal_coverage,
         "convergence_runs": diff,
         "kernel_ops_per_sec": round(
             storm_rep.acked_ops / max(storm_wall, 1e-9), 1),
@@ -2292,12 +2310,29 @@ def stage_config13(scale: str, reps: int, cooldown: float) -> dict:
     window = (storm[0] + quarter, storm[1] - quarter)
 
     # --- storm leg: unavailability window next to goodput dip --------
-    t0 = time.perf_counter()
-    storm_rep = run_chaos_storm(seed=13, steps=steps, storm=storm,
-                                netsplit=window)
-    storm_wall = time.perf_counter() - t0
+    # failsan rides the netsplit storm too: partition-era absorbs
+    # (lag deferrals, ack retries, degraded nacks) must each leave a
+    # visible mark or the round fails by site
+    from fluidframework_tpu.testing import failsan
+
+    failsan.install()
+    try:
+        failsan.reset()
+        t0 = time.perf_counter()
+        storm_rep = run_chaos_storm(seed=13, steps=steps, storm=storm,
+                                    netsplit=window)
+        storm_wall = time.perf_counter() - t0
+        failsan.flush()
+        fail_trips = failsan.trips()
+        signal_coverage = failsan.signal_coverage()
+    finally:
+        failsan.reset()
+        failsan.uninstall()
     assert storm_rep.converged, (
         f"config13 storm diverged: {storm_rep.failures}")
+    assert not fail_trips and signal_coverage == 1.0, (
+        "config13 fault-to-signal accounting failed:\n"
+        + "\n".join(t.describe() for t in fail_trips))
     assert storm_rep.unavailability_s is not None and \
         storm_rep.unavailability_s > 0, (
             "config13's netsplit never entered degraded mode")
@@ -2374,6 +2409,7 @@ def stage_config13(scale: str, reps: int, cooldown: float) -> dict:
         "recovery_time_s": storm_rep.recovery_time_s,
         "faults_fired": storm_rep.fired,
         "chaos_counts": storm_rep.chaos_counts,
+        "signal_coverage": signal_coverage,
         "netsplit_runs": diff,
         "kernel_ops_per_sec": round(
             storm_rep.acked_ops / max(storm_wall, 1e-9), 1),

@@ -201,7 +201,7 @@ def load_allowlist(path: str = ALLOWLIST_PATH) -> list[tuple[str, str]]:
 
 FAMILIES = ("layercheck", "jaxhazards", "lockcheck", "obscheck",
             "qoscheck", "concheck", "shapecheck", "detcheck",
-            "wirecheck")
+            "wirecheck", "failcheck")
 
 # rule id -> owning family: tooling that groups ONE combined run's
 # findings per family (bench's fluidlint_findings records) reads
@@ -227,6 +227,9 @@ FAMILY_RULES = {
     "wirecheck": ("encoder-decoder-drift",
                   "optional-field-unconditional-emit",
                   "ungated-wire-read", "unversioned-frame-field"),
+    "failcheck": ("swallowed-exception",
+                  "broad-except-in-dispatch-loop",
+                  "exception-context-dropped", "return-in-finally"),
 }
 RULE_FAMILY = {
     rule: fam for fam, rules in FAMILY_RULES.items() for rule in rules
@@ -243,6 +246,7 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
     from . import (
         concurrency,
         determinism,
+        failcheck,
         jaxhazards,
         layercheck,
         lockcheck,
@@ -262,6 +266,7 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
         "shapecheck": shapecheck.check,
         "detcheck": determinism.check,
         "wirecheck": wirecheck.check,
+        "failcheck": failcheck.check,
     }
     unknown = [f for f in families if f not in passes]
     if unknown:
@@ -275,7 +280,7 @@ def run_analysis(roots: Iterable[str] = DEFAULT_ROOTS,
     # detcheck and wirecheck resolve through the same interprocedural
     # edges (and pay for the build once)
     GRAPH_FAMILIES = ("jaxhazards", "concheck", "shapecheck",
-                      "detcheck", "wirecheck")
+                      "detcheck", "wirecheck", "failcheck")
     shared_graph = None
     if set(GRAPH_FAMILIES) & set(families):
         from .callgraph import build_callgraph

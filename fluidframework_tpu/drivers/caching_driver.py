@@ -28,6 +28,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import sys
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -95,8 +96,16 @@ class FileSnapshotCache(SnapshotCache):
                         prev.get("cached_at", 0) >= entry.get("cached_at", 0):
                     continue
                 self._entries[doc_id] = entry
-            except (ValueError, KeyError, OSError):
-                continue  # corrupt cache entry: treat as miss
+            except (ValueError, KeyError, OSError) as e:
+                # corrupt cache entry: treat as miss — but say so; a
+                # cache that silently sheds entries looks like a cold
+                # cache and hides real on-disk corruption
+                print(
+                    f"snapshot-cache[{self.root}]: dropping corrupt "
+                    f"entry {name!r} ({type(e).__name__}: {e})",
+                    file=sys.stderr,
+                )
+                continue
 
     @staticmethod
     def _filename(document_id: str) -> str:

@@ -57,6 +57,13 @@ _ROUNDTRIP_MS = obs_metrics.REGISTRY.histogram(
 _THROTTLE_DEFERRALS = obs_metrics.REGISTRY.counter(
     "container_throttle_deferrals_total",
     "flushes that deferred reconnect/resubmit under a throttle nack")
+_DUP_DROPS = obs_metrics.REGISTRY.counter(
+    "container_duplicate_drops_total",
+    "inbound sequenced messages dropped as duplicate deliveries")
+_CATCHUP_OPS = obs_metrics.REGISTRY.counter(
+    "container_catchup_ops_total",
+    "ops refetched from delta storage (gap refetch + reconnect "
+    "catch-up)")
 
 
 class Container(EventEmitter):
@@ -296,6 +303,7 @@ class Container(EventEmitter):
             # (and ONE wording) as the gap-refetch path's check.
             raise self._truncation_error(catchup[0].sequence_number)
         for msg in catchup:
+            _CATCHUP_OPS.inc()
             self._process(msg)
         self._connection = self.service.connect_to_delta_stream(
             self.client_id, self._on_message, self._on_nack
@@ -353,6 +361,7 @@ class Container(EventEmitter):
 
     def _on_message(self, msg: SequencedMessage) -> None:
         if msg.sequence_number <= self._last_enqueued_seq():
+            _DUP_DROPS.inc()
             return  # duplicate delivery
         if msg.sequence_number > self._last_enqueued_seq() + 1:
             # gap: fetch the missing range from delta storage
@@ -373,6 +382,7 @@ class Container(EventEmitter):
                         self._last_enqueued_seq() + 1:
                     raise self._truncation_error(
                         missing.sequence_number)
+                _CATCHUP_OPS.inc()
                 self._enqueue_inbound(missing)
             if msg.sequence_number > self._last_enqueued_seq() + 1:
                 raise self._truncation_error(msg.sequence_number)

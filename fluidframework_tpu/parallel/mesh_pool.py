@@ -116,6 +116,11 @@ _M_MIGRATIONS = obs_metrics.REGISTRY.counter(
 _M_IMBALANCE = obs_metrics.REGISTRY.gauge(
     "mesh_pool_shard_imbalance",
     "hottest-shard heat over mean shard heat (1.0 = balanced)")
+_M_POOL_FAULTS = obs_metrics.REGISTRY.counter(
+    "pool_faults_total",
+    "pool operations deferred or retried under a transient fault "
+    "(shared by NAME across the seq and mesh tiers, like the "
+    "sidecar.pool_* chaos sites)", labelnames=("tier", "op"))
 _M_ROUTE_FALLBACK = obs_metrics.REGISTRY.counter(
     "mesh_pool_route_fallback_total",
     "chunked-route requests served by the scan window body on a "
@@ -419,6 +424,7 @@ class MeshShardedPool:
             # deferred: tails stay past the watermark and apply whole
             # at the next settle — exactly-once by construction (heat
             # also waits; a lagging dispatch must not decay it)
+            _M_POOL_FAULTS.labels(tier="mesh", op="dispatch").inc()
             return []
         pending = {}
         depths = {}
@@ -483,6 +489,7 @@ class MeshShardedPool:
         if _SITE_POOL_MIGRATE.fire() is not None:
             # deferred: migration is opportunistic — heat persists, so
             # a genuinely hot shard re-offers the same move next settle
+            _M_POOL_FAULTS.labels(tier="mesh", op="migrate").inc()
             return
         loads = self.shard_loads()
         hot = max(range(self.n_shards), key=lambda i: (loads[i], -i))

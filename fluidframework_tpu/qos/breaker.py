@@ -48,6 +48,11 @@ _M_TRANSITIONS = obs_metrics.REGISTRY.counter(
 _M_REFUSED = obs_metrics.REGISTRY.counter(
     "qos_breaker_refused_total",
     "calls refused while the breaker was open", labelnames=("name",))
+_M_FAILURES = obs_metrics.REGISTRY.counter(
+    "qos_breaker_failures_total",
+    "failures reported to the breaker (every record_failure, "
+    "including sub-threshold ones that do not open the circuit)",
+    labelnames=("name",))
 
 
 class BreakerOpenError(RuntimeError):
@@ -157,6 +162,7 @@ class CircuitBreaker:
     def record_failure(self, error: Optional[BaseException] = None
                        ) -> None:
         self.last_error = error
+        _M_FAILURES.labels(name=self.name).inc()
         if self._state == STATE_HALF_OPEN:
             self._transition(STATE_OPEN)  # probe failed: back off
             return

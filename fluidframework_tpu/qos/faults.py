@@ -235,6 +235,14 @@ class FaultPlane:
         #: replayable injection sequence the determinism test pins
         self.fired: list[tuple[str, int, str]] = []
         self.flight = FlightRecorder(512, name="chaos")
+        #: observer hooks (testing/failsan.py registers here — qos
+        #: imports nothing above itself, so the observers come to the
+        #: plane): ``on_arm`` callbacks get the schedule AFTER the
+        #: sites are armed; ``on_disarm`` callbacks get the plane
+        #: BEFORE the schedule is cleared, so they can read the seed
+        #: and the fired log of the window that is ending
+        self.on_arm: list = []
+        self.on_disarm: list = []
 
     def site(self, name: str,
              kinds: tuple[str, ...] = ()) -> InjectionSite:
@@ -276,11 +284,15 @@ class FaultPlane:
         _M_ARMED.set(1)
         self.flight.record("arm", seed=schedule.seed,
                            rates=str(sorted(schedule.rates)))
+        for hook in list(self.on_arm):
+            hook(schedule)
 
     def disarm(self) -> None:
         if self.schedule is not None:
             self.flight.record("disarm", seed=self.schedule.seed,
                                fired=len(self.fired))
+            for hook in list(self.on_disarm):
+                hook(self)
         self.schedule = None
         for site in self._sites.values():
             site._arm(None)

@@ -47,6 +47,10 @@ _M_SOURCE = obs_metrics.REGISTRY.gauge(
 _M_TRANSITIONS = obs_metrics.REGISTRY.counter(
     "qos_pressure_transitions_total",
     "tier changes observed by the monitor", labelnames=("to",))
+_M_SOURCE_ERRORS = obs_metrics.REGISTRY.counter(
+    "qos_pressure_source_errors_total",
+    "pressure-source sampling callbacks that raised (source read 0)",
+    labelnames=("source",))
 
 
 @dataclass(frozen=True)
@@ -145,7 +149,10 @@ class PressureMonitor:
                 ratio = max(0.0, float(fn())) / capacity
             except Exception:  # noqa: BLE001 - a dead source reads 0
                 # a sampling fault must not take the admission gate
-                # down with it; the source simply stops contributing
+                # down with it; the source simply stops contributing —
+                # but a silently-dead source under-reports pressure
+                # forever, so count every faulted sample
+                _M_SOURCE_ERRORS.labels(source=name).inc()
                 ratio = 0.0
             by_source[name] = ratio
             _M_SOURCE.labels(source=name).set(ratio)

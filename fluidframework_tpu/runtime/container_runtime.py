@@ -14,6 +14,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
+from ..obs import metrics as obs_metrics
 from ..protocol.messages import SequencedMessage
 from ..utils.events import EventEmitter
 from .datastore import DataStoreRuntime
@@ -25,6 +26,10 @@ from .op_lifecycle import (
     stage_outbound,
 )
 from .shared_object import ChannelRegistry
+
+_RESUBMITS = obs_metrics.REGISTRY.counter(
+    "container_resubmits_total",
+    "pending ops replayed (rebased) on reconnect")
 
 
 @dataclass
@@ -334,6 +339,7 @@ class ContainerRuntime(EventEmitter):
             self.pending.on_submit(op)
         self._outbox.clear()
         for op in self.pending.drain():
+            _RESUBMITS.inc()
             if op.kind in ("attach", "blobAttach"):
                 self._outbox.append(op)  # announcements replay verbatim
                 continue

@@ -499,6 +499,7 @@ class AlfredServer:
         )
         self._session_counter = itertools.count()
         self._sessions: set[_ClientSession] = set()
+        self._handler_tasks: set[asyncio.Task] = set()
         self._server: Optional[asyncio.base_events.Server] = None
         if qos is not None and getattr(qos, "pressure", None) \
                 is not None:
@@ -566,11 +567,24 @@ class AlfredServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+        # wait_closed() only covers the listening socket on 3.10:
+        # actively tear down live sessions (EOFs their read loops)
+        # and wait for the handler tasks, so a loop shutdown right
+        # after stop() can't strand half-torn-down pump coroutines
+        for session in sorted(self._sessions,
+                              key=lambda s: s.session_id):
+            session.close()
+        if self._handler_tasks:
+            await asyncio.gather(
+                *self._handler_tasks, return_exceptions=True)
 
     # ------------------------------------------------------------------
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._handler_tasks.add(task)
         session = _ClientSession(self, writer)
         self._sessions.add(session)
         pump = asyncio.ensure_future(session.writer_loop())
@@ -603,6 +617,8 @@ class AlfredServer:
                 await writer.wait_closed()
             except (ConnectionResetError, BrokenPipeError):
                 pass
+            if task is not None:
+                self._handler_tasks.discard(task)
 
     def _check_read_access(self, session: _ClientSession,
                            doc: str, frame: dict) -> None:
@@ -627,7 +643,7 @@ class AlfredServer:
                 f"not authorized for document {doc!r}: {e} "
                 "(connect_document first, or send a doc:read token "
                 "with the request)"
-            )
+            ) from e
         session.authorized.add(doc)
 
     def _check_write_access(self, session: _ClientSession,
@@ -646,7 +662,7 @@ class AlfredServer:
         except AuthError as e:
             raise PermissionError(
                 f"no write access to document {doc!r}: {e}"
-            )
+            ) from e
         session.write_authorized.add(doc)
 
     def _send_nack(self, session: _ClientSession, doc: str,

@@ -65,8 +65,20 @@ class LocalOrderer:
                 from ..native import NativeSequencerCore
                 self.sequencer = NativeSequencerCore(document_id,
                                                      clock=clock)
-            except (RuntimeError, OSError):
-                pass  # toolchain unavailable: Python path stands in
+            except (RuntimeError, OSError) as e:
+                # toolchain unavailable: the Python path stands in,
+                # but an env var that asked for the native core and
+                # didn't get it must not fall back silently (the PR8
+                # pool-route lesson)
+                import sys
+
+                print(
+                    f"orderer[{document_id}]: FFTPU_NATIVE_SEQUENCER"
+                    f"=1 but the native core is unavailable "
+                    f"({type(e).__name__}: {e}); using the Python "
+                    "sequencer",
+                    file=sys.stderr,
+                )
         self._checkpoint_every = checkpoint_every
         self._since_checkpoint = 0
         # leaves that could not replicate during a quorum-loss

@@ -68,6 +68,25 @@ _SITE_CONSUME = _CHAOS.site("broker.queue_consume", (KIND_DUPLICATE,))
 _SITE_REPL_ACK = _CHAOS.site("repl.append_ack",
                              (KIND_DROP, KIND_ERROR))
 
+# handling accounting for the seams above: every absorbed fault leaves
+# a metric delta (the failsan fault-to-signal contract,
+# docs/ROBUSTNESS.md)
+_M_APPEND_RETRIES = obs_metrics.REGISTRY.counter(
+    "broker_append_retries_total",
+    "transiently-failed queue appends retried once by the producer")
+_M_REDELIVERED = obs_metrics.REGISTRY.counter(
+    "broker_redelivered_records_total",
+    "op records replayed by at-least-once consume redelivery "
+    "(absorbed by deli's clientSequenceNumber dedupe)")
+_M_DEBRIS = obs_metrics.REGISTRY.counter(
+    "storage_crash_debris_cleaned_total",
+    "leftover write-then-rename tmp files cleared at startup (the "
+    "crash-between-write-and-rename state)", labelnames=("file",))
+_M_ACK_RETRIES = obs_metrics.REGISTRY.counter(
+    "repl_ack_retries_total",
+    "transiently-failed follower ack offers retried once "
+    "(second failure skips the round; anti-entropy repairs)")
+
 
 def partition_for(document_id: str, n_partitions: int) -> int:
     """Stable document -> partition routing (the Kafka key hash)."""
@@ -197,6 +216,7 @@ class FileOrderingQueue(OrderingQueue):
             # rename state: the committed file is the truth
             try:
                 os.remove(self._commit_path(p) + ".tmp")
+                _M_DEBRIS.labels(file="queue-offset").inc()
             except OSError:
                 pass
 
@@ -395,11 +415,13 @@ class ReplicatedFileOrderingQueue(FileOrderingQueue):
         for f in self.followers:
             fault = _SITE_REPL_ACK.fire(partition=partition,
                                         offset=offset)
-            if fault is not None and _SITE_REPL_ACK.fire(
-                    partition=partition, offset=offset,
-                    retry=True) is not None:
-                behind.append(f)
-                continue
+            if fault is not None:
+                _M_ACK_RETRIES.inc()
+                if _SITE_REPL_ACK.fire(
+                        partition=partition, offset=offset,
+                        retry=True) is not None:
+                    behind.append(f)
+                    continue
             self._sync_follower(f, partition, offset)
             acked += 1
         for f in behind:
@@ -609,6 +631,7 @@ class Partition:
                 # the duplicate, or the op log's contiguity assert
                 # detonates. Op records only: join/leave are control
                 # records the reference's dedupe does not cover.
+                _M_REDELIVERED.inc()
                 self.document(rec.document_id).process(rec.payload)
             self.checkpoints.completed(rec.offset)
             self._next_offset = rec.offset + 1
@@ -719,6 +742,7 @@ class PartitionedOrderingService:
         # drop-and-reconnect retry has the same shape); a second
         # consecutive fault propagates as the loud error it is
         if _SITE_APPEND.fire(doc=document_id) is not None:
+            _M_APPEND_RETRIES.inc()
             if _SITE_APPEND.fire(doc=document_id, retry=True) \
                     is not None:
                 raise _SITE_APPEND.transient(KIND_ERROR)

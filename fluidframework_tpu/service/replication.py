@@ -144,6 +144,17 @@ def _group_metrics(registry: obs_metrics.MetricsRegistry) -> dict:
             "repl_rejoin_total",
             "followers rejoined via full anti-entropy resync behind "
             "the epoch fence"),
+        # fault-to-signal plane: the two transient-fault absorb
+        # points in _offer_one each leave a visible mark, so a chaos
+        # injection at repl.lag / repl.append_ack is never silent
+        "lag_deferrals": registry.counter(
+            "repl_lag_deferrals_total",
+            "offers absorbed into the follower lag buffer instead "
+            "of acking durably (replication lag deferral)"),
+        "ack_retries": registry.counter(
+            "repl_ack_retries_total",
+            "transiently-failed follower ack offers retried once "
+            "(second failure skips the round; anti-entropy repairs)"),
     }
 
 
@@ -1171,10 +1182,12 @@ class ReplicatedSequencerGroup:
         seq = msg.sequence_number
         if _SITE_LAG.fire(follower=f.node_id, doc=doc,
                           seq=seq) == KIND_DEFER:
+            self.metrics["lag_deferrals"].inc()
             f.buffer_lag(doc, epoch, msg)
             return False
         fault = _SITE_ACK.fire(follower=f.node_id, doc=doc, seq=seq)
         if fault is not None:
+            self.metrics["ack_retries"].inc()
             fault = _SITE_ACK.fire(follower=f.node_id, doc=doc,
                                    seq=seq, retry=True)
             if fault is not None:

@@ -75,6 +75,10 @@ _M_SCRUB = obs_metrics.REGISTRY.counter(
     "storage_scrub_repairs_total",
     "bit-rotted records read-repaired from a quorum peer, by log",
     labelnames=("file",))
+_M_DEBRIS = obs_metrics.REGISTRY.counter(
+    "storage_crash_debris_cleaned_total",
+    "leftover write-then-rename tmp files cleared at startup (the "
+    "crash-between-write-and-rename state)", labelnames=("file",))
 
 # chaos seams (docs/ROBUSTNESS.md): the checkpoint write consults its
 # site per write (error faults exercise the storage breaker); the
@@ -112,7 +116,14 @@ def atomic_write(path: str, data: str) -> None:
     # sibling of the data state above.
     try:
         dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
-    except OSError:  # pragma: no cover - exotic fs
+    except OSError as e:  # pragma: no cover - exotic fs
+        # skipping the directory fsync weakens the crash-durability
+        # story for every write through this path — degrade loudly
+        print(
+            f"atomic_write[{path}]: cannot open directory for fsync "
+            f"({e}); rename durability not guaranteed on this fs",
+            file=sys.stderr,
+        )
         return
     try:
         os.fsync(dfd)
@@ -185,13 +196,13 @@ def read_jsonl_tolerant(path: str, label: str) -> tuple[list, bool]:
             continue
         try:
             row = json.loads(line)
-        except ValueError:
+        except ValueError as e:
             if any(stripped[i + 1:]):
                 raise CorruptRecordError(
                     f"{label} corrupt at line {i + 1} of {path!r}: "
                     "a non-tail torn record is not a crash state",
                     path=path, index=i,
-                )
+                ) from e
             _M_TORN.labels(file=label).inc()
             print(
                 f"storage: discarding torn {label} tail "
@@ -564,6 +575,7 @@ class DocumentStorage:
         # checkpoint (or its absence) is the truth — clear the debris
         try:
             os.remove(self._checkpoint_path + ".tmp")
+            _M_DEBRIS.labels(file="checkpoint").inc()
         except OSError:
             pass
 

@@ -119,7 +119,8 @@ class TenantManager:
         except AuthError:
             raise
         except Exception as e:  # malformed token shape
-            raise AuthError(f"malformed token: {type(e).__name__}")
+            raise AuthError(
+                f"malformed token: {type(e).__name__}") from e
         if claims.get("tenantId") != tenant_id:
             raise AuthError("token tenant mismatch")
         if claims.get("documentId") != document_id:

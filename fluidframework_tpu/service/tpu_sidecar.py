@@ -153,6 +153,15 @@ _M_SPAN_SPLITS = obs_metrics.REGISTRY.counter(
     "egwalker_span_splits_total",
     "would-be span breaks the egwalker compiler absorbed by event "
     "splitting (each one is a saved walker launch)")
+_M_DISPATCH_FAULTS = obs_metrics.REGISTRY.counter(
+    "sidecar_dispatch_faults_total",
+    "device dispatch rounds that failed transiently before mutating "
+    "anything (ops stay queued; the next apply retries exactly)")
+_M_POOL_FAULTS = obs_metrics.REGISTRY.counter(
+    "pool_faults_total",
+    "pool operations deferred or retried under a transient fault "
+    "(shared by NAME across the seq and mesh tiers, like the "
+    "sidecar.pool_* chaos sites)", labelnames=("tier", "op"))
 
 # chaos seams (docs/ROBUSTNESS.md): the dispatch site fires BEFORE the
 # round mutates anything (queues intact, so a retry is exact); the
@@ -237,7 +246,14 @@ def default_executor() -> str:
 
     try:
         backend = jax.default_backend()
-    except RuntimeError:  # pragma: no cover - backend init failure
+    except RuntimeError as e:  # pragma: no cover - backend init failure
+        import sys
+
+        print(
+            "default_executor: jax backend init failed "
+            f"({e}); routing as cpu",
+            file=sys.stderr,
+        )
         backend = "cpu"
     return executor_flip()["winner"] if backend == "tpu" else "scan"
 
@@ -421,6 +437,7 @@ class SeqShardedPool:
         if _SITE_POOL_DISPATCH.fire(tier="seq") is not None:
             # deferred: tails stay past the watermark and apply whole
             # at the next settle — exactly-once by construction
+            _M_POOL_FAULTS.labels(tier="seq", op="dispatch").inc()
             return []
         from ..ops.host_bridge import coalesce_noops
 
@@ -686,7 +703,14 @@ class TpuMergeSidecar:
 
                 try:
                     self.donate = jax.default_backend() == "tpu"
-                except RuntimeError:  # pragma: no cover - init failure
+                except RuntimeError as e:  # pragma: no cover - init
+                    import sys
+
+                    print(
+                        "sidecar: jax backend init failed "
+                        f"({e}); disabling buffer donation",
+                        file=sys.stderr,
+                    )
                     self.donate = False
         self.ladder = ladder or BucketLadder()
         # pool tier: past the ladder top, docs move to a mesh pool —
@@ -1043,6 +1067,7 @@ class TpuMergeSidecar:
         # next apply() retries the identical round
         fault = _SITE_DISPATCH.fire(queued=self.queued_ops)
         if fault is not None:
+            _M_DISPATCH_FAULTS.inc()
             raise _SITE_DISPATCH.transient(fault)
         docs = self.max_docs
         t0 = time.perf_counter()
@@ -1346,6 +1371,7 @@ class TpuMergeSidecar:
             fault = _SITE_POOL_ADMIT.fire(slots=len(fresh))
             if fault is None:
                 return self._pool.admit(fresh, self._streams)
+            _M_POOL_FAULTS.labels(tier="seq", op="admit").inc()
         self.flight.record("recover-pool-admit-degraded",
                            slots=len(fresh))
         return list(fresh)

@@ -91,6 +91,10 @@ _M_DUP_DROPS = obs_metrics.REGISTRY.counter(
     "tree_sidecar_duplicate_drops_total",
     "duplicate sequenced deliveries dropped by the per-document "
     "sequence-number guard")
+_M_DISPATCH_FAULTS = obs_metrics.REGISTRY.counter(
+    "tree_sidecar_dispatch_faults_total",
+    "tree dispatch rounds that failed transiently before mutating "
+    "anything (commits stay queued; the next apply retries exactly)")
 _M_PACK_MS = obs_metrics.REGISTRY.histogram(
     "tree_sidecar_pack_ms", "host half of a tree round (encode+pack)")
 _M_SETTLE_MS = obs_metrics.REGISTRY.histogram(
@@ -134,7 +138,14 @@ def default_tree_executor() -> str:
 
     try:
         backend = jax.default_backend()
-    except RuntimeError:  # pragma: no cover - backend init failure
+    except RuntimeError as e:  # pragma: no cover - backend init failure
+        import sys
+
+        print(
+            "default_tree_executor: jax backend init failed "
+            f"({e}); routing as cpu",
+            file=sys.stderr,
+        )
         backend = "cpu"
     return "macro" if backend == "tpu" else "atom"
 
@@ -518,6 +529,7 @@ class TreeSidecar:
         # exactly the same round
         fault = _SITE_DISPATCH.fire(queued=self.queued_commits)
         if fault is not None:
+            _M_DISPATCH_FAULTS.inc()
             raise _SITE_DISPATCH.transient(fault)
         t0 = time.perf_counter()
         packed: dict[int, list[dict]] = {}

@@ -84,6 +84,7 @@ from ..qos.faults import (
     TransientFault,
     standard_rates,
 )
+from ..service import ingress as ingress_mod
 from ..service.ingress import (
     AlfredServer,
     _ClientSession,
@@ -125,7 +126,10 @@ class ChaosTransport:
             self.server._dispatch(self.session, frame, nbytes)
         except Exception as e:  # noqa: BLE001 - the server-loop catch
             # mirror AlfredServer._handle: a dispatch fault answers
-            # with an error frame and the server keeps serving
+            # with an error frame and the server keeps serving —
+            # including the errors-sent accounting, so faults injected
+            # under this in-proc transport stay signal-visible
+            ingress_mod._ERRORS_OUT.inc()
             self.session.send({
                 "type": "error",
                 "rid": frame.get("rid"),

@@ -39,11 +39,15 @@ import pytest  # noqa: E402
 # deterministic-plane components. wiresan (testing/wiresan.py)
 # completes the set: the patched pack/dispatch wire seams trip on any
 # registered frame type carrying a field absent from the WIRE_SCHEMA
-# registry. The autouse guard below fails any test that trips any of
-# the four.
+# registry. failsan (testing/failsan.py) is the fifth: it hooks the
+# chaos plane's arm/disarm and trips when an injected fault maps to
+# no observable signal (fault-to-signal accounting,
+# docs/ROBUSTNESS.md). The autouse guard below fails any test that
+# trips any of the five.
 _SANITIZE = os.environ.get("FFTPU_SANITIZE") == "1"
 if _SANITIZE:
     from fluidframework_tpu.testing import detsan as _detsan
+    from fluidframework_tpu.testing import failsan as _failsan
     from fluidframework_tpu.testing import jitsan as _jitsan
     from fluidframework_tpu.testing import sanitizer as _fluidsan
     from fluidframework_tpu.testing import wiresan as _wiresan
@@ -52,6 +56,7 @@ if _SANITIZE:
     _jitsan.install()
     _detsan.install()
     _wiresan.install()
+    _failsan.install()
 
 
 @pytest.fixture(autouse=True)
@@ -60,13 +65,14 @@ def _fluidsan_trip_guard():
         yield
         return
     from fluidframework_tpu.testing import (
-        detsan, jitsan, sanitizer, wiresan,
+        detsan, failsan, jitsan, sanitizer, wiresan,
     )
 
     before = len(sanitizer.trips())
     before_jit = len(jitsan.trips())
     before_det = len(detsan.trips())
     before_wire = len(wiresan.trips())
+    before_fail = len(failsan.trips())
     yield
     fresh = sanitizer.trips()[before:]
     if fresh:
@@ -93,6 +99,15 @@ def _fluidsan_trip_guard():
         pytest.fail(
             "wiresan tripped during this test:\n"
             + "\n".join(t.describe() for t in fresh_wire)
+        )
+    # trips() evaluates any window closed during this test — the
+    # chaos harnesses disarm before quiesce, so teardown is the first
+    # point where every recovery signal has landed
+    fresh_fail = failsan.trips()[before_fail:]
+    if fresh_fail:
+        pytest.fail(
+            "failsan tripped during this test:\n"
+            + "\n".join(t.describe() for t in fresh_fail)
         )
 
 

@@ -1,0 +1,373 @@
+"""failcheck unit tests: per rule, a true-positive fixture (the
+analyzer catches the planted silent error path) and a clean-pass
+fixture (the loud idiom sails through), plus the machinery the live
+gate depends on — callgraph-propagated loudness, the SILENT_HANDLERS
+registry escape hatch and its staleness detector, and the
+line-insertion-stable ordinal keys. Fixtures are PARSED, never
+imported.
+"""
+import textwrap
+
+from fluidframework_tpu.analysis import failcheck
+from fluidframework_tpu.analysis.core import (
+    run_analysis,
+    walk_python_files,
+)
+
+
+def _lint(tmp_path, files):
+    for relpath, source in files.items():
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    return run_analysis(
+        roots=sorted({p.split("/")[0] for p in files}),
+        families=["failcheck"],
+        repo_root=str(tmp_path),
+    )
+
+
+# ------------------------------------------------- swallowed-exception
+
+
+def test_swallowed_exception_rule(tmp_path):
+    """A serving-path handler that absorbs the exception with no
+    signal fails; every loudness arm (re-raise, metric inc, stderr,
+    errorish return value, flight record) passes; a justified inline
+    disable suppresses."""
+    findings = _lint(tmp_path, {
+        "service/handler.py": """
+            import sys
+
+            class Svc:
+                def recv(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError:
+                        return None                         # BAD
+
+                def loud_metric(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError:
+                        self.metrics["faults"].inc()
+                        return None
+
+                def loud_stderr(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError as e:
+                        print(f"recv: {e}", file=sys.stderr)
+                        return None
+
+                def loud_reraise(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError as e:
+                        raise RuntimeError("apply") from e
+
+                def loud_error_value(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError as e:
+                        return self._nack(frame, e)
+
+                def loud_flight(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError as e:
+                        self.flight.record("fault", err=str(e))
+                        return None
+
+                def reviewed(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except KeyError:  # fluidlint: disable=swallowed-exception -- test fixture
+                        return None
+        """,
+    })
+    assert [f.key for f in findings] == [
+        "handler.py:Svc.recv:except-ValueError"]
+    assert findings[0].rule == "swallowed-exception"
+
+
+def test_swallowed_exception_out_of_scope_components_pass(tmp_path):
+    """obs/ and utils/ handlers ARE the signal emitters — the rule
+    only patrols the serving-plane path components."""
+    findings = _lint(tmp_path, {
+        "obs/quiet.py": """
+            def sample():
+                try:
+                    return read()
+                except OSError:
+                    return None
+        """,
+        "utils/quiet.py": """
+            def load(path):
+                try:
+                    return open(path).read()
+                except OSError:
+                    return ""
+        """,
+    })
+    assert findings == []
+
+
+def test_silent_handler_registry_escape(tmp_path, monkeypatch):
+    """A reviewed SILENT_HANDLERS entry exempts exactly its site —
+    an unregistered silent handler in the same module still fails
+    (registry, not allowlist)."""
+    monkeypatch.setitem(
+        failcheck.SILENT_HANDLERS,
+        ("service/reg.py", "Svc.absorb:except-OSError"),
+        "test fixture: reviewed absorb")
+    findings = _lint(tmp_path, {
+        "service/reg.py": """
+            class Svc:
+                def absorb(self, path):
+                    try:
+                        return open(path).read()
+                    except OSError:
+                        return None                     # registered
+
+                def other(self, path):
+                    try:
+                        return open(path).read()
+                    except OSError:
+                        return None                     # BAD
+        """,
+    })
+    assert [f.key for f in findings] == [
+        "reg.py:Svc.other:except-OSError"]
+
+
+def test_loudness_resolves_through_callgraph(tmp_path):
+    """A handler delegating to a repo helper that itself re-raises
+    or emits a signal is loud — including through a two-hop chain;
+    delegating to a silent helper is not."""
+    findings = _lint(tmp_path, {
+        "service/deleg.py": """
+            class Svc:
+                def via_reraise(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError as e:
+                        self._note(e)
+                        return None
+
+                def _note(self, e):
+                    self._escalate(e)
+
+                def _escalate(self, e):
+                    raise RuntimeError("fault") from e
+
+                def via_silence(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError as e:
+                        self._shrug(e)
+                        return None                     # BAD
+
+                def _shrug(self, e):
+                    self.last = e
+        """,
+    })
+    assert [f.key for f in findings] == [
+        "deleg.py:Svc.via_silence:except-ValueError"]
+
+
+# ------------------------------------------ broad-except-in-dispatch-loop
+
+
+def test_broad_except_in_dispatch_loop_rule(tmp_path):
+    """A bare/``except Exception`` in a DISPATCH_LOOPS-registered
+    function without loud teardown is the PR2 quietly-dead-thread
+    shape — and wins the dedup over plain swallowed-exception (the
+    more specific diagnosis). The same broad except with a loud
+    teardown passes; a NARROW silent except in the loop falls back
+    to swallowed-exception."""
+    findings = _lint(tmp_path, {
+        "service/tpu_sidecar.py": """
+            import sys
+
+            class Sidecar:
+                def _dispatch(self, ops):
+                    try:
+                        self._run(ops)
+                    except Exception:
+                        self.dead = True                # BAD (broad)
+                    try:
+                        self._settle_rows(ops)
+                    except KeyError:
+                        self.skipped += 1               # BAD (narrow)
+
+                def apply(self, ops):
+                    try:
+                        self._run(ops)
+                    except Exception as e:
+                        print(f"apply died: {e}", file=sys.stderr)
+                        raise
+        """,
+    })
+    by_rule = {f.rule: f.key for f in findings}
+    assert by_rule == {
+        "broad-except-in-dispatch-loop":
+            "tpu_sidecar.py:Sidecar._dispatch:broad-except",
+        "swallowed-exception":
+            "tpu_sidecar.py:Sidecar._dispatch:except-KeyError",
+    }
+
+
+# ---------------------------------------------- exception-context-dropped
+
+
+def test_exception_context_dropped_rule(tmp_path):
+    """``raise New(...)`` without ``from`` inside an except severs
+    the causal chain; ``from e`` chains, ``from None`` is an explicit
+    reviewed severing, and ``raise e`` re-raises the same exception —
+    all three pass."""
+    findings = _lint(tmp_path, {
+        "service/chain.py": """
+            class Svc:
+                def recv(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError:
+                        raise RuntimeError("apply")     # BAD
+
+                def chained(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError as e:
+                        raise RuntimeError("apply") from e
+
+                def severed(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError:
+                        raise RuntimeError("apply") from None
+
+                def same(self, frame):
+                    try:
+                        return self._apply(frame)
+                    except ValueError as e:
+                        raise e
+        """,
+    })
+    assert [(f.rule, f.key) for f in findings] == [
+        ("exception-context-dropped",
+         "chain.py:Svc.recv:raise-RuntimeError")]
+
+
+# ------------------------------------------------------ return-in-finally
+
+
+def test_return_in_finally_rule(tmp_path):
+    """return/break/continue in a finally discards the in-flight
+    exception (language semantics — applies everywhere, not just the
+    serving planes); a break bound to a loop INSIDE the finalbody and
+    a return inside a nested def are that scope's business."""
+    findings = _lint(tmp_path, {
+        "ops/cleanup.py": """
+            def leak(path):
+                try:
+                    return parse(path)
+                finally:
+                    return None                         # BAD
+
+            def sweep(paths):
+                for p in paths:
+                    try:
+                        consume(p)
+                    finally:
+                        continue                        # BAD
+
+            def fine(paths):
+                try:
+                    consume(paths)
+                finally:
+                    for p in paths:
+                        if stale(p):
+                            break                       # inner loop's
+
+            def fine_nested(path):
+                try:
+                    return parse(path)
+                finally:
+                    def report():
+                        return "done"
+                    note(report)
+        """,
+    })
+    assert [(f.rule, f.key) for f in findings] == [
+        ("return-in-finally", "cleanup.py:leak:finally-return"),
+        ("return-in-finally", "cleanup.py:sweep:finally-continue"),
+    ]
+
+
+# ------------------------------------------------- keys + registry hygiene
+
+
+def test_handler_ordinal_keys_are_line_insertion_stable(tmp_path):
+    """Two same-typed handlers in one scope get distinct ordinal
+    keys, and inserting lines above both changes neither (the
+    allowlist-key contract every family shares)."""
+    src = """
+        class Svc:
+            def recv(self, frame):
+                try:
+                    a = self._head(frame)
+                except OSError:
+                    a = None                            # BAD
+                try:
+                    b = self._body(frame)
+                except OSError:
+                    b = None                            # BAD
+                return a, b
+    """
+    baseline = _lint(tmp_path, {"service/two.py": src})
+    assert sorted(f.key for f in baseline) == [
+        "two.py:Svc.recv:except-OSError",
+        "two.py:Svc.recv:except-OSError2",
+    ]
+    shifted = _lint(tmp_path / "shifted", {
+        # indentation matches the fixture body so dedent still
+        # normalizes it; only the line NUMBERS move
+        "service/two.py": "\n        # shifted\n        # shifted"
+                          + src})
+    assert sorted(f.key for f in baseline) == \
+        sorted(f.key for f in shifted)
+    assert sorted(f.line for f in baseline) != \
+        sorted(f.line for f in shifted)
+
+
+def test_stale_silent_handlers_detects_ghost_entries(tmp_path):
+    """A registry entry whose site vanished — or went intrinsically
+    loud — describes nothing and must be reported stale; the entry
+    matching a still-silent handler stays live."""
+    (tmp_path / "service").mkdir(parents=True)
+    (tmp_path / "service" / "reg.py").write_text(textwrap.dedent("""
+        class Svc:
+            def absorb(self, path):
+                try:
+                    return open(path).read()
+                except OSError:
+                    return None
+
+            def loud(self, path):
+                try:
+                    return open(path).read()
+                except OSError as e:
+                    raise RuntimeError(str(e)) from e
+    """))
+    files = walk_python_files(["service"], repo_root=str(tmp_path))
+    registry = {
+        ("service/reg.py", "Svc.absorb:except-OSError"): "live",
+        ("service/reg.py", "Svc.loud:except-OSError"): "went loud",
+        ("service/reg.py", "Svc.gone:except-ValueError"): "vanished",
+    }
+    stale = failcheck.stale_silent_handlers(files, registry)
+    assert sorted(stale) == [
+        ("service/reg.py", "Svc.gone:except-ValueError"),
+        ("service/reg.py", "Svc.loud:except-OSError"),
+    ]

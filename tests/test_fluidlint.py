@@ -1847,10 +1847,42 @@ def test_finding_keys_are_line_free_across_all_families(tmp_path):
                     return (frame["a"], frame["b"],
                             frame.get("trace"))
         """,
+        # failcheck: all four exception-flow rules fire — swallowed
+        # except (two same-typed in one scope for the ordinal keys),
+        # broad except in a DISPATCH_LOOPS function, context-dropping
+        # re-raise, and a return-in-finally
+        "fluidframework_tpu/service/tpu_sidecar.py": """
+            class Sidecar:
+                def _dispatch(self, ops):
+                    try:
+                        self._run(ops)
+                    except Exception:
+                        self.dead = True
+
+                def recv(self, frame):
+                    try:
+                        a = self._head(frame)
+                    except OSError:
+                        a = None
+                    try:
+                        b = self._body(frame)
+                    except OSError:
+                        b = None
+                    try:
+                        return a, b
+                    except ValueError:
+                        raise RuntimeError("pair")
+
+                def drain(self, q):
+                    try:
+                        return q.pop()
+                    finally:
+                        return None
+        """,
     }
     key_families = ["layercheck", "jaxhazards", "lockcheck",
                     "qoscheck", "concheck", "shapecheck", "detcheck",
-                    "wirecheck"]
+                    "wirecheck", "failcheck"]
     baseline = _lint(tmp_path, dict(files), families=key_families)
     assert len(baseline) >= 5
     assert {"donated-buffer-reuse", "unladdered-jit-shape",
@@ -1861,6 +1893,17 @@ def test_finding_keys_are_line_free_across_all_families(tmp_path):
     assert {"encoder-decoder-drift",
             "optional-field-unconditional-emit", "ungated-wire-read",
             "unversioned-frame-field"} <= _rules(baseline)
+    assert {"swallowed-exception", "broad-except-in-dispatch-loop",
+            "exception-context-dropped",
+            "return-in-finally"} <= _rules(baseline)
+    fail_keys = sorted(
+        f.key for f in baseline if f.rule == "swallowed-exception")
+    # qualname-ordinal handler keys: same-typed handlers in one scope
+    # stay distinct and line-free
+    assert fail_keys == [
+        "tpu_sidecar.py:Sidecar.recv:except-OSError",
+        "tpu_sidecar.py:Sidecar.recv:except-OSError2",
+    ]
     wire_keys = sorted(
         f.key for f in baseline
         if f.rule == "unversioned-frame-field")

@@ -653,6 +653,10 @@ def test_wirecheck_family_is_in_the_gate():
     assert "wirecheck" in core.FAMILIES
 
 
+def test_failcheck_family_is_in_the_gate():
+    assert "failcheck" in core.FAMILIES
+
+
 def test_wall_clock_unrouted_rule(tmp_path):
     """detcheck:wall-clock-unrouted — a direct time.* read reachable
     from a deterministic-contract root (here: a fixture matching the
@@ -901,6 +905,53 @@ def test_wire_schema_registry_resolves_to_live_traffic():
     assert registry, "WIRE_SCHEMA registry unexpectedly empty"
 
 
+def test_failcheck_live_tree_is_clean_with_empty_allowlist():
+    """The acceptance bar (the PR1/PR5/PR11/PR19 precedent): zero
+    live failcheck findings over the whole repo and NOTHING
+    grandfathered — every silent handler the family found live was
+    either made loud or reviewed into SILENT_HANDLERS in the PR that
+    introduced it. The registry is the escape hatch, never the
+    allowlist."""
+    kept, _stale, allowlist = _gate()
+    fail_rules = set(core.FAMILY_RULES["failcheck"])
+    fail_kept = [f for f in kept if f.rule in fail_rules]
+    assert fail_kept == [], \
+        "\n".join(f.format() for f in fail_kept)
+    grandfathered = [e for e in allowlist if e[0] in fail_rules]
+    assert grandfathered == [], (
+        "failcheck findings must be fixed, never grandfathered: "
+        f"{grandfathered}"
+    )
+
+
+def test_silent_handlers_registry_resolves_to_live_sites():
+    """Registry non-vacuity (the WALL_CLOCK_SINKS contract): every
+    SILENT_HANDLERS entry must still match a statically-silent
+    handler at its site — an entry whose handler vanished or went
+    loud describes nothing and fails HERE so the registry can only
+    describe live code. The staleness detector itself is pinned
+    non-vacuous with a planted ghost."""
+    from fluidframework_tpu.analysis import failcheck
+
+    files = core.walk_python_files(["fluidframework_tpu"])
+    stale = failcheck.stale_silent_handlers(files)
+    assert stale == [], (
+        "stale SILENT_HANDLERS entries (no statically-silent "
+        f"handler at the registered site anymore — delete): {stale}"
+    )
+    assert failcheck.SILENT_HANDLERS, "registry unexpectedly empty"
+
+    # the staleness detector itself is not vacuous
+    ghost = ("service/ingress.py",
+             "AlfredServer._handle:except-ZeroDivisionError")
+    assert ghost not in failcheck.SILENT_HANDLERS
+    try:
+        failcheck.SILENT_HANDLERS[ghost] = "test-only ghost entry"
+        assert ghost in failcheck.stale_silent_handlers(files)
+    finally:
+        del failcheck.SILENT_HANDLERS[ghost]
+
+
 def test_wall_clock_sinks_registry_resolves_to_live_sites():
     """Registry non-vacuity (the FANOUT_GATES contract): every
     WALL_CLOCK_SINKS entry must still name a function (or module)
@@ -943,7 +994,10 @@ def test_family_rules_map_stays_complete():
                  "iteration-order-leak", "hash-order-dependence",
                  "encoder-decoder-drift",
                  "optional-field-unconditional-emit",
-                 "ungated-wire-read", "unversioned-frame-field"):
+                 "ungated-wire-read", "unversioned-frame-field",
+                 "swallowed-exception",
+                 "broad-except-in-dispatch-loop",
+                 "exception-context-dropped", "return-in-finally"):
         assert rule in core.RULE_FAMILY, rule
 
 
@@ -981,7 +1035,7 @@ def test_shapecheck_live_tree_is_clean_within_the_ratchet():
 
 
 def test_combined_gate_run_stays_under_budget():
-    """The CI/tooling satellite: nine families, one shared
+    """The CI/tooling satellite: ten families, one shared
     callgraph, one budget. A blowup here means a family stopped
     reusing the per-run graph or a fixpoint regressed superlinear."""
     _gate()  # ensures the timed run happened (memoized per session)
